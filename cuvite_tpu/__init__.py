@@ -1,0 +1,29 @@
+"""cuvite_tpu — a TPU-native distributed Louvain community-detection framework.
+
+A brand-new JAX/XLA implementation with the capabilities of pnnl/cuVite:
+multi-phase distributed Louvain modularity optimization over vertex-sharded
+CSR graphs, with community exchange via mesh collectives, inter-phase graph
+coarsening, and Vite-binary graph I/O.
+
+The compute path is fully jitted: one compiled step per phase, edge-parallel
+segment reductions instead of the reference's per-vertex hash maps
+(cf. /root/reference/louvain.cpp:2384-2431), and `jax.lax` collectives over a
+device mesh instead of MPI (cf. /root/reference/louvain.cpp:2588-3116).
+"""
+
+from cuvite_tpu.core.types import Policy, default_policy
+from cuvite_tpu.core.graph import Graph
+from cuvite_tpu.core.distgraph import DistGraph
+from cuvite_tpu.louvain.driver import louvain_phases, LouvainResult
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Policy",
+    "default_policy",
+    "Graph",
+    "DistGraph",
+    "louvain_phases",
+    "LouvainResult",
+    "__version__",
+]

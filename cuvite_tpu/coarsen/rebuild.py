@@ -1,0 +1,53 @@
+"""Inter-phase graph coarsening: communities become vertices.
+
+Equivalent of distbuildNextLevelGraph (/root/reference/rebuild.cpp:430-454):
+
+  1. renumber surviving communities to a dense contiguous id space
+     (distReNumber, rebuild.cpp:27-242) — here a host-side np.unique over the
+     community vector (the per-phase dynamic shape lives on the host; device
+     shapes stay static within a phase);
+  2. aggregate edges community->community (fill_newEdgesMap,
+     rebuild.cpp:244-279) — here one sparse-matrix coalesce;
+  3. re-partition the new graph over the mesh (send_newEdges,
+     rebuild.cpp:281-428) — here simply rebuilding DistGraph shards.
+
+Intra-community weight collapses onto the diagonal as self-loops, which is
+what keeps modularity consistent across phases.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+from cuvite_tpu.core.graph import Graph
+from cuvite_tpu.core.types import Policy
+
+
+def renumber_communities(comm: np.ndarray) -> tuple[np.ndarray, int]:
+    """Map arbitrary community labels to dense ids [0, nc).
+
+    Returns (dense_labels, nc).  Matches the reference's sorted-order
+    renumbering (smallest original label -> 0; rebuild.cpp:167-197 and
+    main.cpp:374-394 both sort before assigning new ids).
+    """
+    uniq, dense = np.unique(comm, return_inverse=True)
+    return dense.astype(np.int64), int(len(uniq))
+
+
+def coarsen_graph(
+    graph: Graph, dense_comm: np.ndarray, nc: int, policy: Policy | None = None
+) -> Graph:
+    """Build the next-phase graph whose vertices are the nc communities."""
+    policy = policy or graph.policy
+    src = dense_comm[graph.sources()]
+    dst = dense_comm[graph.tails.astype(np.int64)]
+    w = graph.weights.astype(np.float64)
+    mat = sp.coo_matrix((w, (src, dst)), shape=(nc, nc)).tocsr()  # sums dups
+    offsets = mat.indptr.astype(np.int64)
+    return Graph(
+        offsets=offsets,
+        tails=mat.indices.astype(policy.vertex_dtype),
+        weights=mat.data.astype(policy.weight_dtype),
+        policy=policy,
+    )

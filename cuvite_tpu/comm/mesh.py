@@ -1,0 +1,30 @@
+"""Device mesh helpers.
+
+The reference bootstraps distribution with MPI_Init + a shared-memory
+sub-communicator for GPU binding (/root/reference/main.cpp:67-74,
+louvain_cuda.cu:1634-1669).  The TPU-native analog is a 1-D
+`jax.sharding.Mesh` over all addressable devices; multi-host deployments call
+`jax.distributed.initialize` before building it.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+VERTEX_AXIS = "v"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            devices = devices[:n_devices]
+    return Mesh(np.array(devices), (VERTEX_AXIS,))
+
+
+def shard_1d(mesh: Mesh, arr, replicate: bool = False):
+    """Place an array on the mesh, sharded along axis 0 (or replicated)."""
+    spec = P() if replicate else P(VERTEX_AXIS)
+    return jax.device_put(arr, NamedSharding(mesh, spec))

@@ -1,0 +1,182 @@
+"""Vertex-sharded distributed graph.
+
+Equivalent of the reference `DistGraph` (/root/reference/distgraph.hpp:27-57):
+global sizes + a partition table ``parts[nshards+1]`` of contiguous vertex
+ranges, with owner lookup and local<->global translation
+(/root/reference/distgraph.hpp:180-222).
+
+The TPU-native difference: instead of per-rank local CSR objects, the
+partition materializes one set of **equal-size padded device slabs** — an
+edge-parallel struct-of-arrays `(src, dst, w, mask)` per shard, all shards the
+same shape — so a single `shard_map`-jitted step runs the whole mesh SPMD with
+static shapes.  Padding edges carry ``src == nv_pad`` (an out-of-range segment
+id, dropped by segment sums) and zero weight.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from cuvite_tpu.core.graph import Graph
+from cuvite_tpu.core.types import Policy, next_pow2
+
+
+def uniform_parts(num_vertices: int, nshards: int) -> np.ndarray:
+    """Contiguous near-equal vertex ranges (cf. /root/reference/distgraph.cpp:115-121)."""
+    chunk = num_vertices // nshards
+    rem = num_vertices % nshards
+    sizes = np.full(nshards, chunk, dtype=np.int64)
+    sizes[:rem] += 1
+    parts = np.zeros(nshards + 1, dtype=np.int64)
+    np.cumsum(sizes, out=parts[1:])
+    return parts
+
+
+def balanced_parts(graph: Graph, nshards: int) -> np.ndarray:
+    """Edge-balanced contiguous ranges: each shard owns ~ne/nshards edges
+    (cf. balanceEdges, /root/reference/distgraph.cpp:22-66, the `-b` flag)."""
+    ne = graph.num_edges
+    nv = graph.num_vertices
+    targets = (np.arange(1, nshards, dtype=np.int64) * ne) // nshards
+    cuts = np.searchsorted(graph.offsets[1:], targets, side="left") + 1
+    parts = np.concatenate([[0], np.clip(cuts, 0, nv), [nv]]).astype(np.int64)
+    # Enforce monotonicity if some shard would be empty.
+    np.maximum.accumulate(parts, out=parts)
+    return parts
+
+
+@dataclasses.dataclass
+class Shard:
+    """One device's padded edge slab plus its owned vertex range."""
+
+    base: int       # first owned global vertex id
+    bound: int      # one past last owned global vertex id
+    src: np.ndarray   # [ne_pad] LOCAL source index in [0, nv_pad); pad = nv_pad
+    dst: np.ndarray   # [ne_pad] GLOBAL tail vertex id; pad = 0
+    w: np.ndarray     # [ne_pad] weight; pad = 0
+    n_real_edges: int
+
+
+@dataclasses.dataclass
+class DistGraph:
+    """Global graph + partition into equal-shape shards.
+
+    `nv_pad` is the per-shard owned-vertex count after padding (same for every
+    shard); `ne_pad` is the per-shard edge-slab length.  Total padded vertex
+    space is ``nshards * nv_pad``; global ids are remapped so shard s owns
+    ``[s*nv_pad, s*nv_pad + (parts[s+1]-parts[s]))`` — i.e. padding vertices
+    are interleaved at the tail of each shard's range, and arrays for the
+    padded id space concatenate shard slices directly.
+    """
+
+    graph: Graph
+    parts: np.ndarray        # [nshards+1] original-id partition table
+    nshards: int
+    nv_pad: int              # owned vertices per shard, padded
+    ne_pad: int              # edge slots per shard, padded
+    shards: list              # list[Shard]
+    old_to_pad: np.ndarray   # [nv] original global id -> padded global id
+    pad_to_old: np.ndarray   # [nshards*nv_pad] padded id -> original id (or -1)
+
+    @property
+    def total_vertices(self) -> int:
+        return self.graph.num_vertices
+
+    @property
+    def total_padded_vertices(self) -> int:
+        return self.nshards * self.nv_pad
+
+    @property
+    def total_edges(self) -> int:
+        return self.graph.num_edges
+
+    def owner_of_padded(self, v: int) -> int:
+        return v // self.nv_pad
+
+    @staticmethod
+    def build(
+        graph: Graph,
+        nshards: int,
+        balanced: bool = False,
+        pad_pow2: bool = True,
+    ) -> "DistGraph":
+        nv = graph.num_vertices
+        parts = balanced_parts(graph, nshards) if balanced else uniform_parts(nv, nshards)
+        owned = np.diff(parts)
+        nv_pad = int(owned.max()) if len(owned) else 1
+        if pad_pow2:
+            nv_pad = next_pow2(max(nv_pad, 1))
+
+        # Remap original ids -> padded id space (shard-contiguous).
+        old_to_pad = np.empty(nv, dtype=np.int64)
+        pad_to_old = np.full(nshards * nv_pad, -1, dtype=np.int64)
+        for s in range(nshards):
+            lo, hi = int(parts[s]), int(parts[s + 1])
+            old_to_pad[lo:hi] = s * nv_pad + np.arange(hi - lo)
+            pad_to_old[s * nv_pad : s * nv_pad + (hi - lo)] = np.arange(lo, hi)
+
+        sources = graph.sources().astype(np.int64)
+        counts = [
+            int(graph.offsets[parts[s + 1]] - graph.offsets[parts[s]])
+            for s in range(nshards)
+        ]
+        ne_pad = max(max(counts) if counts else 1, 1)
+        if pad_pow2:
+            ne_pad = next_pow2(ne_pad)
+
+        vdt = graph.policy.vertex_dtype
+        wdt = graph.policy.weight_dtype
+        shards = []
+        for s in range(nshards):
+            e0 = int(graph.offsets[parts[s]])
+            e1 = int(graph.offsets[parts[s + 1]])
+            n = e1 - e0
+            src_l = np.full(ne_pad, nv_pad, dtype=vdt)  # out-of-range pad
+            dst_g = np.zeros(ne_pad, dtype=vdt)
+            w = np.zeros(ne_pad, dtype=wdt)
+            src_l[:n] = (old_to_pad[sources[e0:e1]] - s * nv_pad).astype(vdt)
+            dst_g[:n] = old_to_pad[graph.tails[e0:e1].astype(np.int64)].astype(vdt)
+            w[:n] = graph.weights[e0:e1]
+            shards.append(
+                Shard(
+                    base=int(parts[s]),
+                    bound=int(parts[s + 1]),
+                    src=src_l,
+                    dst=dst_g,
+                    w=w,
+                    n_real_edges=n,
+                )
+            )
+        return DistGraph(
+            graph=graph,
+            parts=parts,
+            nshards=nshards,
+            nv_pad=nv_pad,
+            ne_pad=ne_pad,
+            shards=shards,
+            old_to_pad=old_to_pad,
+            pad_to_old=pad_to_old,
+        )
+
+    # ---- stacked views for device placement -------------------------------
+
+    def stacked_edges(self):
+        """Return (src, dst, w) each of shape [nshards*ne_pad], shard-major,
+        ready to be sharded along axis 0 of a 1-D mesh."""
+        src = np.concatenate([sh.src for sh in self.shards])
+        dst = np.concatenate([sh.dst for sh in self.shards])
+        w = np.concatenate([sh.w for sh in self.shards])
+        return src, dst, w
+
+    def padded_weighted_degrees(self) -> np.ndarray:
+        """vDegree in the padded id space (padding vertices get 0)."""
+        wd = self.graph.weighted_degrees().astype(np.float64)
+        out = np.zeros(self.total_padded_vertices, dtype=np.float64)
+        out[self.old_to_pad] = wd
+        return out.astype(self.graph.policy.weight_dtype)
+
+    def vertex_mask(self) -> np.ndarray:
+        """Boolean mask over the padded id space marking real vertices."""
+        return self.pad_to_old >= 0

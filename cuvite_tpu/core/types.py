@@ -1,0 +1,68 @@
+"""Scalar type policy.
+
+The reference switches vertex-id and weight width with a single compile-time
+macro `USE_32_BIT_GRAPH` (/root/reference/edge.hpp:10-20).  Here the same
+choice is a runtime `Policy` object threaded through graph construction and
+kernels.  Defaults are TPU-friendly: int32 ids (graphs up to 2^31-1 vertices
+per shard) and float32 weights; float64 accumulation is available on CPU for
+oracle tests when `jax_enable_x64` is set.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+# Driver safety nets (cf. /root/reference/utils.hpp:17-19, main.cpp:486-494).
+TERMINATION_PHASE_COUNT = 200
+MAX_TOTAL_ITERATIONS = 10_000
+
+# Early-termination constants (cf. /root/reference/louvain.hpp:74-80).
+ET_CUTOFF = 0.90  # fraction of frozen vertices that stops the iteration loop
+P_CUTOFF = 0.02   # probability floor below which a vertex freezes (ET modes 2/4)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    """Dtype policy for graph arrays and kernel accumulators."""
+
+    vertex_dtype: np.dtype = np.dtype(np.int32)
+    weight_dtype: np.dtype = np.dtype(np.float32)
+    # Dtype used for global scalar reductions (modularity terms). float32 is
+    # fine up to ~10^7 edges; large graphs should use float64 on CPU oracles
+    # and pairwise/tree summation on TPU (jnp.sum is tree-based on TPU).
+    accum_dtype: np.dtype = np.dtype(np.float32)
+
+    @property
+    def vertex_np(self) -> np.dtype:
+        return self.vertex_dtype
+
+    @property
+    def weight_np(self) -> np.dtype:
+        return self.weight_dtype
+
+    def sentinel_vertex(self) -> int:
+        """Max value of the vertex dtype, used as +inf for segment-min."""
+        return int(np.iinfo(self.vertex_dtype).max)
+
+
+def default_policy() -> Policy:
+    return Policy()
+
+
+def wide_policy() -> Policy:
+    """64-bit ids + weights: the `USE_32_BIT_GRAPH`-off configuration."""
+    return Policy(
+        vertex_dtype=np.dtype(np.int64),
+        weight_dtype=np.dtype(np.float64),
+        accum_dtype=np.dtype(np.float64),
+    )
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (>=1). Used to pad shapes so phases with
+    shrinking graphs reuse compiled executables instead of recompiling."""
+    if n <= 1:
+        return 1
+    return 1 << (int(n - 1).bit_length())

@@ -1,0 +1,105 @@
+"""Vite binary graph format reader/writer.
+
+On-disk layout (cf. loadDistGraphMPIIO, /root/reference/distgraph.cpp:99-197):
+
+    [nv: GraphElem] [ne: GraphElem]
+    [edgeListIndexes: (nv+1) x GraphElem]
+    [edges: ne x Edge{tail: GraphElem, weight: GraphWeight}]
+
+GraphElem/GraphWeight are int64/double by default, or int32/float when the
+reference is compiled with `USE_32_BIT_GRAPH` (/root/reference/edge.hpp:10-20).
+The Edge struct has no padding in either width.
+
+Reads use `np.memmap`, so a multi-host deployment can read only its vertex
+range (the analog of the per-rank `MPI_File_read_at` slices,
+/root/reference/distgraph.cpp:130-190).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from cuvite_tpu.core.graph import Graph
+from cuvite_tpu.core.types import Policy, default_policy, wide_policy
+
+
+def _elem_dtype(bits64: bool) -> np.dtype:
+    return np.dtype("<i8") if bits64 else np.dtype("<i4")
+
+
+def _edge_dtype(bits64: bool) -> np.dtype:
+    if bits64:
+        return np.dtype([("tail", "<i8"), ("weight", "<f8")])
+    return np.dtype([("tail", "<i4"), ("weight", "<f4")])
+
+
+def read_vite(
+    path: str,
+    bits64: bool = True,
+    policy: Policy | None = None,
+    vertex_range: tuple[int, int] | None = None,
+) -> Graph:
+    """Read a Vite binary graph (optionally only ``[lo, hi)`` vertex rows).
+
+    When ``vertex_range`` is given, the returned CSR is the local slice with
+    offsets re-based to start at 0 (cf. /root/reference/distgraph.cpp:194-197).
+    """
+    policy = policy or (wide_policy() if bits64 else default_policy())
+    elem = _elem_dtype(bits64)
+    edge = _edge_dtype(bits64)
+    header = np.fromfile(path, dtype=elem, count=2)
+    if len(header) != 2:
+        raise ValueError(f"{path}: truncated Vite header")
+    nv, ne = int(header[0]), int(header[1])
+    import os
+
+    expected = 2 * elem.itemsize + (nv + 1) * elem.itemsize + ne * edge.itemsize
+    actual = os.path.getsize(path)
+    if nv < 0 or ne < 0 or actual < expected:
+        raise ValueError(
+            f"{path}: header (nv={nv}, ne={ne}) implies {expected} bytes but "
+            f"file has {actual} — wrong bits64={bits64} flag or corrupt file"
+        )
+    lo, hi = (0, nv) if vertex_range is None else vertex_range
+    if not (0 <= lo <= hi <= nv):
+        raise ValueError(f"bad vertex range {lo, hi} for nv={nv}")
+
+    offsets_map = np.memmap(
+        path, dtype=elem, mode="r", offset=2 * elem.itemsize, shape=(nv + 1,)
+    )
+    offsets = np.array(offsets_map[lo : hi + 1], dtype=np.int64)
+    e0, e1 = int(offsets[0]), int(offsets[-1])
+    if e0 < 0 or e1 > ne or np.any(np.diff(offsets) < 0):
+        raise ValueError(
+            f"{path}: non-monotone CSR offsets — wrong bits64={bits64} flag "
+            f"or corrupt file"
+        )
+    edges_offset = 2 * elem.itemsize + (nv + 1) * elem.itemsize
+    edges_map = np.memmap(
+        path, dtype=edge, mode="r", offset=edges_offset + e0 * edge.itemsize,
+        shape=(e1 - e0,),
+    )
+    tails = np.array(edges_map["tail"], dtype=policy.vertex_dtype)
+    weights = np.array(edges_map["weight"], dtype=policy.weight_dtype)
+    return Graph(
+        offsets=offsets - e0,
+        tails=tails,
+        weights=weights,
+        policy=policy,
+    )
+
+
+def write_vite(path: str, graph: Graph, bits64: bool = True) -> None:
+    """Write a graph in the Vite binary format
+    (cf. writeGraph, /root/reference/distgraph.cpp:936-1014)."""
+    elem = _elem_dtype(bits64)
+    edge = _edge_dtype(bits64)
+    nv = graph.num_vertices
+    ne = graph.num_edges
+    with open(path, "wb") as f:
+        np.array([nv, ne], dtype=elem).tofile(f)
+        graph.offsets.astype(elem).tofile(f)
+        rec = np.empty(ne, dtype=edge)
+        rec["tail"] = graph.tails
+        rec["weight"] = graph.weights
+        rec.tofile(f)

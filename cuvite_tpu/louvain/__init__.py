@@ -1,0 +1,1 @@
+"""cuvite_tpu.louvain"""

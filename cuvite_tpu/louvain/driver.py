@@ -1,0 +1,264 @@
+"""Multi-phase Louvain driver.
+
+Replicates the control flow of the reference application loop
+(/root/reference/main.cpp:218-495 and louvain.cpp:425-588) on top of the
+jitted step:
+
+  - per-phase iteration loop with the `(currMod - prevMod) < threshold`
+    stopping rule and the pastComm/currComm/targetComm rotation semantics
+    (the returned assignment is the last one whose modularity improvement
+    passed the threshold, louvain.cpp:541-576);
+  - threshold cycling 1e-3 -> 1e-6 over a 13-phase cycle when enabled
+    (main.cpp:225-239), with the final safety 1e-6 pass (main.cpp:432-442);
+  - inter-phase coarsening + cross-phase label composition
+    (main.cpp:374-403, :410-428);
+  - termination guards: <= 200 phases, <= 10000 total iterations
+    (utils.hpp:17-19, main.cpp:486-494).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cuvite_tpu.coarsen.rebuild import coarsen_graph, renumber_communities
+from cuvite_tpu.comm.mesh import VERTEX_AXIS, make_mesh, shard_1d
+from cuvite_tpu.core.distgraph import DistGraph
+from cuvite_tpu.core.graph import Graph
+from cuvite_tpu.core.types import (
+    MAX_TOTAL_ITERATIONS,
+    TERMINATION_PHASE_COUNT,
+)
+from cuvite_tpu.louvain.step import make_sharded_step, make_single_step
+
+
+def threshold_for_phase(short_phase: int) -> float:
+    """Threshold-cycling schedule (main.cpp:225-237)."""
+    sp = short_phase % 13
+    if sp <= 2:
+        return 1.0e-3
+    if sp <= 6:
+        return 1.0e-4
+    if sp <= 9:
+        return 1.0e-5
+    return 1.0e-6
+
+
+@dataclasses.dataclass
+class PhaseStats:
+    phase: int
+    modularity: float
+    iterations: int
+    num_vertices: int
+    num_edges: int
+    seconds: float
+
+
+@dataclasses.dataclass
+class LouvainResult:
+    communities: np.ndarray   # [nv original] dense community label per vertex
+    modularity: float
+    phases: list
+    total_iterations: int
+    total_seconds: float
+
+    @property
+    def num_communities(self) -> int:
+        return int(self.communities.max()) + 1 if len(self.communities) else 0
+
+
+def _device_dtype(dt: np.dtype) -> np.dtype:
+    """Clamp 64-bit host dtypes to 32-bit unless jax_enable_x64 is on, so
+    wide (bits64) graphs run on TPU without per-array truncation warnings."""
+    if jax.config.jax_enable_x64:
+        return dt
+    if dt == np.float64:
+        return np.dtype(np.float32)
+    if dt == np.int64:
+        return np.dtype(np.int32)
+    return dt
+
+
+# Compiled-step cache: phases whose pow2-padded shapes coincide reuse the
+# same jitted callable (jax.jit caches compilations per callable object, so
+# recreating the closure each phase would retrace and recompile every time).
+_STEP_CACHE: dict = {}
+
+
+def _get_step(mesh, nv_total: int, accum_dtype) -> object:
+    key = (
+        None if mesh is None else tuple(d.id for d in mesh.devices.flat),
+        nv_total,
+        np.dtype(accum_dtype).name if accum_dtype is not None else None,
+    )
+    step = _STEP_CACHE.get(key)
+    if step is None:
+        if mesh is not None and np.prod(mesh.devices.shape) > 1:
+            step = make_sharded_step(mesh, VERTEX_AXIS, nv_total,
+                                     accum_dtype=accum_dtype)
+        else:
+            step = make_single_step(nv_total, accum_dtype=accum_dtype)
+        _STEP_CACHE[key] = step
+    return step
+
+
+class PhaseRunner:
+    """Runs the iteration loop of one phase on a device mesh."""
+
+    def __init__(self, dg: DistGraph, mesh=None):
+        self.dg = dg
+        self.mesh = mesh
+        nv_total = dg.total_padded_vertices
+        src, dst, w = dg.stacked_edges()
+        vdeg = dg.padded_weighted_degrees()
+        vdt = _device_dtype(dg.graph.policy.vertex_dtype)
+        wdt = _device_dtype(dg.graph.policy.weight_dtype)
+        src, dst = src.astype(vdt), dst.astype(vdt)
+        w, vdeg = w.astype(wdt), vdeg.astype(wdt)
+        comm0 = np.arange(nv_total, dtype=vdt)
+        adt = _device_dtype(dg.graph.policy.accum_dtype)
+        self._step = _get_step(mesh, nv_total, adt)
+        if mesh is not None and np.prod(mesh.devices.shape) > 1:
+            assert dg.nshards == int(np.prod(mesh.devices.shape))
+            self.src = shard_1d(mesh, src)
+            self.dst = shard_1d(mesh, dst)
+            self.w = shard_1d(mesh, w)
+            self.vdeg = shard_1d(mesh, vdeg)
+            self.comm0 = shard_1d(mesh, comm0)
+        else:
+            assert dg.nshards == 1
+            self.src = jnp.asarray(src)
+            self.dst = jnp.asarray(dst)
+            self.w = jnp.asarray(w)
+            self.vdeg = jnp.asarray(vdeg)
+            self.comm0 = jnp.asarray(comm0)
+        tw = dg.graph.total_edge_weight_twice()
+        self.constant = jnp.asarray(1.0 / tw, dtype=wdt)
+
+    def run(self, threshold: float, lower: float) -> tuple[np.ndarray, float, int]:
+        """One phase: returns (communities in padded space, modularity, iters).
+
+        Semantics of louvain.cpp:471-588: iterate until the modularity gain
+        drops below `threshold`; return the assignment *before* the last two
+        speculative move rounds (cvect = pastComm) and its modularity.
+        """
+        comm = self.comm0
+        past = comm
+        prev_mod = lower
+        iters = 0
+        while True:
+            iters += 1
+            target, mod, _ = self._step(
+                self.src, self.dst, self.w, comm, self.vdeg, self.constant
+            )
+            curr_mod = float(mod)
+            if (curr_mod - prev_mod) < threshold:
+                break
+            prev_mod = max(curr_mod, lower)
+            past = comm
+            comm = target
+            if iters >= MAX_TOTAL_ITERATIONS:
+                break
+        return np.asarray(jax.device_get(past)), prev_mod, iters
+
+
+def louvain_phases(
+    graph: Graph,
+    nshards: int = 1,
+    mesh=None,
+    threshold: float = 1.0e-6,
+    threshold_cycling: bool = False,
+    one_phase: bool = False,
+    balanced: bool = False,
+    max_phases: int = TERMINATION_PHASE_COUNT,
+    verbose: bool = False,
+) -> LouvainResult:
+    """Full multi-phase Louvain (the main.cpp:218-495 loop)."""
+    if mesh is None and nshards > 1:
+        mesh = make_mesh(nshards)
+
+    nv0 = graph.num_vertices
+    comm_all = np.arange(nv0, dtype=np.int64)
+    if graph.num_edges == 0:
+        # Edgeless graph: every vertex is its own community, Q = 0.
+        return LouvainResult(
+            communities=comm_all, modularity=0.0, phases=[],
+            total_iterations=0, total_seconds=0.0,
+        )
+    phases: list[PhaseStats] = []
+    prev_mod = -1.0
+    tot_iters = 0
+    t_start = time.perf_counter()
+    phase = 0
+    g = graph
+
+    while True:
+        th = threshold_for_phase(phase) if (threshold_cycling and not one_phase) \
+            else threshold
+        t1 = time.perf_counter()
+        dg = DistGraph.build(g, nshards, balanced=balanced)
+        runner = PhaseRunner(dg, mesh=mesh)
+        comm_pad, curr_mod, iters = runner.run(th, lower=-1.0)
+        t2 = time.perf_counter()
+        tot_iters += iters
+
+        # Map padded-space communities back to original-id labels for the
+        # real vertices of this phase's graph.
+        comm_old = comm_pad[dg.old_to_pad]  # label (padded id) per real vertex
+
+        gained = (curr_mod - prev_mod) > th
+        if gained:
+            dense, nc = renumber_communities(comm_old)
+            comm_all = dense[comm_all]
+            phases.append(PhaseStats(
+                phase=phase, modularity=curr_mod, iterations=iters,
+                num_vertices=g.num_vertices, num_edges=g.num_edges,
+                seconds=t2 - t1,
+            ))
+            if verbose:
+                print(f"Level {phase}, Modularity: {curr_mod:.6f}, "
+                      f"Iterations: {iters}, nv: {g.num_vertices}, "
+                      f"time: {t2 - t1:.3f}s")
+            if one_phase:
+                prev_mod = curr_mod
+                break
+            g = coarsen_graph(g, dense, nc)
+            prev_mod = curr_mod
+            phase += 1
+        else:
+            # Safety net: when cycling exits early, run one final 1e-6 pass
+            # (main.cpp:432-442).  Note: lower must be -1 (not prev_mod), or
+            # the restarted sweep — whose first-iteration modularity is that
+            # of the identity assignment — terminates immediately and the
+            # pass is dead.
+            if threshold_cycling and not one_phase and phase < 10 and th > 1.0e-6:
+                comm_pad, curr_mod, iters = runner.run(1.0e-6, lower=-1.0)
+                tot_iters += iters
+                comm_old = comm_pad[dg.old_to_pad]
+                if (curr_mod - prev_mod) > 1.0e-6:
+                    dense, nc = renumber_communities(comm_old)
+                    comm_all = dense[comm_all]
+                    prev_mod = curr_mod
+                    phases.append(PhaseStats(
+                        phase=phase, modularity=curr_mod, iterations=iters,
+                        num_vertices=g.num_vertices, num_edges=g.num_edges,
+                        seconds=time.perf_counter() - t1,
+                    ))
+            break
+
+        if phase >= max_phases or tot_iters > MAX_TOTAL_ITERATIONS:
+            break
+
+    # Final contiguous renumber of the composed labels (main.cpp:374-394).
+    dense_all, _ = renumber_communities(comm_all)
+    return LouvainResult(
+        communities=dense_all,
+        modularity=prev_mod,
+        phases=phases,
+        total_iterations=tot_iters,
+        total_seconds=time.perf_counter() - t_start,
+    )

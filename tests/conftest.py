@@ -1,0 +1,67 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+The TPU-native analog of the reference's "multi-node without a cluster"
+strategy (oversubscribed MPI ranks on one node, /root/reference/README:48-53):
+XLA's host-platform device count gives N fake devices so every collective and
+sharding path runs exactly as it would on an N-chip mesh.
+"""
+
+import os
+import sys
+
+# Must precede any jax backend initialization.  Note: the axon TPU plugin in
+# this image registers itself from sitecustomize and wins over a
+# JAX_PLATFORMS env var, so the platform is forced via jax.config below.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from cuvite_tpu.core.graph import Graph  # noqa: E402
+
+
+def karate_edges():
+    """Zachary's karate club (34 vertices, 78 edges) — the reference's
+    conventional smoke-test input (/root/reference/README:53)."""
+    import networkx as nx
+
+    g = nx.karate_club_graph()
+    e = np.array(g.edges(), dtype=np.int64)
+    return 34, e[:, 0], e[:, 1]
+
+
+@pytest.fixture(scope="session")
+def karate() -> Graph:
+    nv, s, d = karate_edges()
+    return Graph.from_edges(nv, s, d)
+
+
+@pytest.fixture(scope="session")
+def ring8() -> Graph:
+    """8-cycle: trivial known structure."""
+    s = np.arange(8)
+    d = (s + 1) % 8
+    return Graph.from_edges(8, s, d)
+
+
+@pytest.fixture(scope="session")
+def two_cliques() -> Graph:
+    """Two K5 cliques joined by a single bridge edge: unambiguous communities."""
+    edges = []
+    for b in (0, 5):
+        for i in range(5):
+            for j in range(i + 1, 5):
+                edges.append((b + i, b + j))
+    edges.append((0, 5))
+    e = np.array(edges, dtype=np.int64)
+    return Graph.from_edges(10, e[:, 0], e[:, 1])
