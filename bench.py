@@ -1,0 +1,67 @@
+"""Benchmark: multi-phase Louvain TEPS on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric follows the reference's TEPS accounting (main.cpp:448, :509):
+    TEPS = sum over phases (phase_edges * phase_iterations) / clustering time
+i.e. traversed-edges-per-second across the whole clustering run.
+
+Baseline (BASELINE.json): >= 1B edges/sec aggregate on a v5p-64, i.e.
+15.625M edges/sec/chip.  vs_baseline = value / 15.625e6.
+
+Env knobs: BENCH_SCALE (R-MAT scale, default 20), BENCH_EF (edge factor,
+default 16), BENCH_GRAPH=rmat|rgg.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_EDGES_PER_SEC_PER_CHIP = 1.0e9 / 64.0
+
+
+def main():
+    scale = int(os.environ.get("BENCH_SCALE", "20"))
+    ef = int(os.environ.get("BENCH_EF", "16"))
+    kind = os.environ.get("BENCH_GRAPH", "rmat")
+
+    from cuvite_tpu.io.generate import generate_rgg, generate_rmat
+    from cuvite_tpu.louvain.driver import louvain_phases
+
+    t0 = time.perf_counter()
+    if kind == "rgg":
+        graph = generate_rgg(1 << scale, seed=1)
+    else:
+        graph = generate_rmat(scale, edge_factor=ef, seed=1)
+    gen_s = time.perf_counter() - t0
+    print(f"# graph: {kind} scale={scale} nv={graph.num_vertices} "
+          f"ne={graph.num_edges} gen={gen_s:.1f}s", file=sys.stderr)
+
+    # Warm-up phase-0 compile so TEPS measures steady-state execution.
+    res = louvain_phases(graph, one_phase=True, threshold=1e-2)
+    del res
+
+    t1 = time.perf_counter()
+    res = louvain_phases(graph, verbose=False)
+    wall = time.perf_counter() - t1
+
+    traversed = sum(p.num_edges * p.iterations for p in res.phases)
+    clustering_s = sum(p.seconds for p in res.phases) or wall
+    teps = traversed / clustering_s
+
+    print(f"# Q={res.modularity:.5f} phases={len(res.phases)} "
+          f"iters={res.total_iterations} clustering={clustering_s:.2f}s "
+          f"wall={wall:.2f}s", file=sys.stderr)
+    print(json.dumps({
+        "metric": "louvain_teps_per_chip",
+        "value": round(teps, 1),
+        "unit": "traversed_edges/sec",
+        "vs_baseline": round(teps / BASELINE_EDGES_PER_SEC_PER_CHIP, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

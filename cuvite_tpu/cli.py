@@ -1,0 +1,194 @@
+"""Command-line driver: the equivalent of the `graphClustering` binary.
+
+Collapses the reference's getopt flags + ~25 compile-time macros
+(/root/reference/main.cpp:587-712, README:54-102) into one typed config.
+Flag parity (reference -> here):
+
+    -f FILE   -> --file FILE          (Vite binary input)
+    -b        -> --balanced           (edge-balanced vertex partition)
+    -c NC     -> --coloring NC        (distance-1 coloring, phase 0)
+    -d NC     -> --vertex-ordering NC (color-based vertex ordering)
+    -o        -> --output             (write .communities file)
+    -t TYPE   -> --early-term TYPE    (1-4)
+    -a ALPHA  -> --et-delta ALPHA     (probability decay, modes 2/4)
+    -i        -> --threshold-cycling
+    -g FILE   -> --ground-truth FILE  (LFR format comparison; 1-based ids
+                 by default, pass --gt-zero-based for 0-based truth files —
+                 the reference's -z flag flips the same offset,
+                 main.cpp:627-629)
+    -p        -> --one-phase
+    -n NV     -> --generate NV        (in-memory RGG)
+    -e PCT    -> --random-edges PCT
+    -s FILE   -> --write-graph FILE   (save generated graph)
+    -j        -> --just-process       (load/generate only, no clustering)
+    USE_32_BIT_GRAPH -> --bits64 / default 32-bit
+    nprocs    -> --shards N           (device mesh size)
+
+Run: python -m cuvite_tpu.cli --file karate.bin --output
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="cuvite-tpu",
+        description="TPU-native distributed Louvain community detection",
+    )
+    src = p.add_argument_group("input")
+    src.add_argument("--file", "-f", help="Vite binary graph file")
+    src.add_argument("--bits64", action="store_true",
+                     help="64-bit vertex ids / double weights in the file")
+    src.add_argument("--generate", "-n", type=int, metavar="NV",
+                     help="generate an in-memory RGG with NV vertices")
+    src.add_argument("--rmat", type=int, metavar="SCALE",
+                     help="generate an R-MAT graph with 2^SCALE vertices")
+    src.add_argument("--edge-factor", type=int, default=16)
+    src.add_argument("--random-edges", "-e", type=int, default=0, metavar="PCT",
+                     help="percent extra random edges for generated graphs")
+    src.add_argument("--seed", type=int, default=1)
+    src.add_argument("--write-graph", "-s", metavar="FILE",
+                     help="write the generated graph in Vite binary format")
+
+    run = p.add_argument_group("clustering")
+    run.add_argument("--shards", type=int, default=1,
+                     help="number of mesh devices (vertex shards)")
+    run.add_argument("--balanced", "-b", action="store_true",
+                     help="edge-balanced partition")
+    run.add_argument("--threshold", type=float, default=1e-6)
+    run.add_argument("--threshold-cycling", "-i", action="store_true")
+    run.add_argument("--one-phase", "-p", action="store_true")
+    run.add_argument("--early-term", "-t", type=int, choices=[1, 2, 3, 4],
+                     help="early termination mode")
+    run.add_argument("--et-delta", "-a", type=float, default=0.25)
+    run.add_argument("--coloring", "-c", type=int, metavar="NC",
+                     help="distance-1 coloring with NC max colors")
+    run.add_argument("--vertex-ordering", "-d", type=int, metavar="NC",
+                     help="color-based vertex ordering with NC max colors")
+
+    out = p.add_argument_group("output")
+    out.add_argument("--output", "-o", action="store_true",
+                     help="write <input>.communities")
+    out.add_argument("--ground-truth", "-g", metavar="FILE",
+                     help="compare against LFR ground truth")
+    out.add_argument("--gt-zero-based", action="store_true",
+                     help="ground-truth community ids start at 0")
+    out.add_argument("--just-process", "-j", action="store_true")
+    out.add_argument("--json", action="store_true",
+                     help="emit a machine-readable summary line")
+    out.add_argument("--quiet", action="store_true")
+    return p
+
+
+def validate(args) -> None:
+    if not args.file and args.generate is None and args.rmat is None:
+        raise SystemExit("Must specify --file, --generate or --rmat")
+    if args.random_edges and args.generate is None:
+        raise SystemExit("--random-edges requires --generate")
+    if args.coloring and args.vertex_ordering:
+        raise SystemExit("Cannot enable both --coloring and --vertex-ordering")
+    if args.coloring or args.vertex_ordering:
+        raise SystemExit(
+            "--coloring / --vertex-ordering are not implemented yet"
+        )
+    if args.one_phase and args.threshold_cycling:
+        raise SystemExit("Cannot combine --one-phase with --threshold-cycling")
+    if args.early_term in (2, 4) and not (0.0 <= args.et_delta <= 1.0):
+        raise SystemExit("--et-delta must be in [0, 1]")
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    validate(args)
+
+    from cuvite_tpu.core.graph import Graph  # noqa: F401 (re-export context)
+    from cuvite_tpu.evaluate.compare import (
+        compare_communities, load_ground_truth, write_communities,
+    )
+    from cuvite_tpu.evaluate.modularity import modularity
+    from cuvite_tpu.io.generate import generate_rgg, generate_rmat
+    from cuvite_tpu.io.vite import read_vite, write_vite
+    from cuvite_tpu.louvain.driver import louvain_phases
+
+    t0 = time.perf_counter()
+    if args.file:
+        graph = read_vite(args.file, bits64=args.bits64)
+        name = args.file
+    elif args.rmat is not None:
+        graph = generate_rmat(args.rmat, edge_factor=args.edge_factor,
+                              seed=args.seed)
+        name = f"rmat{args.rmat}"
+    else:
+        graph = generate_rgg(args.generate, nshards=args.shards,
+                             random_edge_percent=args.random_edges,
+                             seed=args.seed)
+        name = f"rgg{args.generate}"
+    load_s = time.perf_counter() - t0
+    if not args.quiet:
+        print(f"Loaded graph: {graph.num_vertices} vertices, "
+              f"{graph.num_edges} directed edges ({load_s:.2f}s)")
+
+    if args.write_graph:
+        write_vite(args.write_graph, graph, bits64=args.bits64)
+        if not args.quiet:
+            print(f"Wrote graph to {args.write_graph}")
+    if args.just_process:
+        return 0
+
+    res = louvain_phases(
+        graph,
+        nshards=args.shards,
+        threshold=args.threshold,
+        threshold_cycling=args.threshold_cycling,
+        one_phase=args.one_phase,
+        balanced=args.balanced,
+        et_mode=args.early_term or 0,
+        et_delta=args.et_delta,
+        verbose=not args.quiet,
+    )
+
+    q = modularity(graph, res.communities)
+    teps = sum(p.num_edges * p.iterations for p in res.phases) / max(
+        sum(p.seconds for p in res.phases), 1e-9)
+    if not args.quiet:
+        print(f"Final modularity: {q:.6f} "
+              f"({res.num_communities} communities, "
+              f"{res.total_iterations} iterations, "
+              f"{res.total_seconds:.2f}s, TEPS {teps:.3g})")
+
+    if args.output:
+        out = name + ".communities"
+        write_communities(out, res.communities)
+        if not args.quiet:
+            print(f"Wrote communities to {out}")
+
+    if args.ground_truth:
+        truth = load_ground_truth(args.ground_truth,
+                                  zero_based=args.gt_zero_based)
+        cmp_res = compare_communities(truth, res.communities)
+        print(cmp_res.report())
+
+    if args.json:
+        print(json.dumps({
+            "graph": name,
+            "nv": graph.num_vertices,
+            "ne": graph.num_edges,
+            "modularity": q,
+            "communities": res.num_communities,
+            "iterations": res.total_iterations,
+            "phases": len(res.phases),
+            "seconds": res.total_seconds,
+            "teps": teps,
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

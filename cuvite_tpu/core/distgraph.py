@@ -101,11 +101,17 @@ class DistGraph:
         nshards: int,
         balanced: bool = False,
         pad_pow2: bool = True,
+        min_nv_pad: int = 1,
+        min_ne_pad: int = 1,
     ) -> "DistGraph":
+        """``min_nv_pad``/``min_ne_pad`` set a floor on the padded shapes so
+        successive coarsened phases (whose graphs shrink fast) land on the
+        same compiled executable instead of recompiling per phase."""
         nv = graph.num_vertices
         parts = balanced_parts(graph, nshards) if balanced else uniform_parts(nv, nshards)
         owned = np.diff(parts)
         nv_pad = int(owned.max()) if len(owned) else 1
+        nv_pad = max(nv_pad, min_nv_pad)
         if pad_pow2:
             nv_pad = next_pow2(max(nv_pad, 1))
 
@@ -122,7 +128,7 @@ class DistGraph:
             int(graph.offsets[parts[s + 1]] - graph.offsets[parts[s]])
             for s in range(nshards)
         ]
-        ne_pad = max(max(counts) if counts else 1, 1)
+        ne_pad = max(max(counts) if counts else 1, 1, min_ne_pad)
         if pad_pow2:
             ne_pad = next_pow2(ne_pad)
 

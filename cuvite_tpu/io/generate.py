@@ -1,0 +1,124 @@
+"""In-memory graph generators.
+
+- `generate_rgg`: random geometric graph with the reference's structure
+  (/root/reference/distgraph.cpp:341-933): nv points in the unit square,
+  shard s owning n=nv/p points whose Y coordinates live in the strip
+  [s/p, (s+1)/p); an edge connects every pair within Euclidean distance
+  rn = (rc + rt)/2 (distgraph.cpp:347-349), weighted by the distance.
+  Coordinates come from the SAME Park-Miller LCG stream as the reference
+  (X from slice [0, n), Y rescaled into the strip from slice [n, 2n) —
+  distgraph.cpp:426-434), so the point set is bit-identical for a given
+  (nv, nshards, seed=1).  Neighbor search uses a KD-tree instead of the
+  reference's O(n^2) loops + up/down ghost Sendrecv (distgraph.cpp:483-620):
+  same edge set, not a translation.
+- `generate_rmat`: Graph500-style R-MAT generator (a=0.57, b=0.19, c=0.19)
+  for the benchmark configs in BASELINE.md (not present in the reference,
+  which defers non-RGG formats to external converters, README:36-40).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.spatial import cKDTree
+
+from cuvite_tpu.core.graph import Graph
+from cuvite_tpu.core.types import Policy, default_policy
+from cuvite_tpu.utils.rng import lcg_stream
+
+
+def rgg_radius(nv: int) -> float:
+    """rn = (rc + rt)/2 (distgraph.cpp:347-349)."""
+    rc = np.sqrt(np.log(nv) / (np.pi * nv))
+    rt = np.sqrt(2.0736 / nv)
+    return float((rc + rt) / 2.0)
+
+
+def rgg_points(nv: int, nshards: int, seed: int = 1) -> tuple[np.ndarray, np.ndarray]:
+    """Reference-parity coordinates: X uniform [0,1), Y in the owner's strip."""
+    n = nv // nshards
+    xs, ys = [], []
+    for s in range(nshards):
+        # Each shard draws 2n numbers from ITS OWN slice of the global
+        # stream: LCG(seed) with rank offset s*2n (utils.hpp parallel
+        # prefix with n_=2n per rank).
+        r = lcg_stream(seed, nshards * 2 * n, lo=s * 2 * n, hi=(s + 1) * 2 * n)
+        xs.append(r[:n])
+        ys.append(s / nshards + r[n:] * (1.0 / nshards))  # rescale(lo, 1/p)
+    return np.concatenate(xs), np.concatenate(ys)
+
+
+def generate_rgg(
+    nv: int,
+    nshards: int = 1,
+    random_edge_percent: int = 0,
+    seed: int = 1,
+    policy: Policy | None = None,
+) -> Graph:
+    """Random geometric graph equivalent to `-n nv` (+ optional `-p pct`)."""
+    policy = policy or default_policy()
+    n = nv // nshards
+    nv_eff = n * nshards  # reference drops the remainder (distgraph.cpp:380)
+    rn = rgg_radius(nv_eff)
+    if nshards > 1 and 1.0 / nshards <= rn:
+        raise ValueError(
+            f"strip width 1/{nshards} must exceed rn={rn:.4f} "
+            f"(distgraph.cpp:351)"
+        )
+    x, y = rgg_points(nv_eff, nshards, seed)
+    pts = np.stack([x, y], axis=1)
+    tree = cKDTree(pts)
+    pairs = tree.query_pairs(r=rn, output_type="ndarray")  # i < j, ed <= rn
+    d = np.sqrt(((pts[pairs[:, 0]] - pts[pairs[:, 1]]) ** 2).sum(axis=1))
+    src, dst, w = pairs[:, 0], pairs[:, 1], d
+
+    if random_edge_percent > 0:
+        # Extra long-range edges, ~pct% of the local edge count
+        # (distgraph.cpp:652-842).  Random pairs, weight = distance.
+        n_extra = int(random_edge_percent * len(pairs)) // 100
+        rng = np.random.default_rng(seed)
+        es = rng.integers(0, nv_eff, size=n_extra)
+        ed_ = rng.integers(0, nv_eff, size=n_extra)
+        keep = es != ed_
+        es, ed_ = es[keep], ed_[keep]
+        wx = np.sqrt(((pts[es] - pts[ed_]) ** 2).sum(axis=1))
+        src = np.concatenate([src, es])
+        dst = np.concatenate([dst, ed_])
+        w = np.concatenate([w, wx])
+
+    return Graph.from_edges(nv_eff, src, dst, weights=w, policy=policy)
+
+
+def generate_rmat(
+    scale: int,
+    edge_factor: int = 16,
+    seed: int = 1,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    policy: Policy | None = None,
+) -> Graph:
+    """Graph500 R-MAT: 2^scale vertices, edge_factor * 2^scale edges
+    (before dedup/symmetrization), unit weights."""
+    policy = policy or default_policy()
+    nv = 1 << scale
+    ne = edge_factor << scale
+    rng = np.random.default_rng(seed)
+    src = np.zeros(ne, dtype=np.int64)
+    dst = np.zeros(ne, dtype=np.int64)
+    ab = a + b
+    a_norm = a / ab
+    c_norm = c / (1.0 - ab)
+    for _ in range(scale):
+        r1 = rng.random(ne)
+        r2 = rng.random(ne)
+        src_bit = r1 > ab
+        dst_bit = np.where(
+            src_bit, r2 > c_norm, r2 > a_norm
+        )
+        src = (src << 1) | src_bit
+        dst = (dst << 1) | dst_bit
+    # permute vertex ids to break the degree/id correlation
+    perm = rng.permutation(nv)
+    src, dst = perm[src], perm[dst]
+    keep = src != dst
+    return Graph.from_edges(nv, src[keep], dst[keep], policy=policy)

@@ -30,7 +30,9 @@ from cuvite_tpu.comm.mesh import VERTEX_AXIS, make_mesh, shard_1d
 from cuvite_tpu.core.distgraph import DistGraph
 from cuvite_tpu.core.graph import Graph
 from cuvite_tpu.core.types import (
+    ET_CUTOFF,
     MAX_TOTAL_ITERATIONS,
+    P_CUTOFF,
     TERMINATION_PHASE_COUNT,
 )
 from cuvite_tpu.louvain.step import make_sharded_step, make_single_step
@@ -122,6 +124,7 @@ class PhaseRunner:
         comm0 = np.arange(nv_total, dtype=vdt)
         adt = _device_dtype(dg.graph.policy.accum_dtype)
         self._step = _get_step(mesh, nv_total, adt)
+        self.real_mask = dg.vertex_mask()
         if mesh is not None and np.prod(mesh.devices.shape) > 1:
             assert dg.nshards == int(np.prod(mesh.devices.shape))
             self.src = shard_1d(mesh, src)
@@ -129,6 +132,7 @@ class PhaseRunner:
             self.w = shard_1d(mesh, w)
             self.vdeg = shard_1d(mesh, vdeg)
             self.comm0 = shard_1d(mesh, comm0)
+            self.real_mask_dev = shard_1d(mesh, self.real_mask)
         else:
             assert dg.nshards == 1
             self.src = jnp.asarray(src)
@@ -136,29 +140,69 @@ class PhaseRunner:
             self.w = jnp.asarray(w)
             self.vdeg = jnp.asarray(vdeg)
             self.comm0 = jnp.asarray(comm0)
+            self.real_mask_dev = jnp.asarray(self.real_mask)
         tw = dg.graph.total_edge_weight_twice()
         self.constant = jnp.asarray(1.0 / tw, dtype=wdt)
 
-    def run(self, threshold: float, lower: float) -> tuple[np.ndarray, float, int]:
+    def run(
+        self,
+        threshold: float,
+        lower: float,
+        et_mode: int = 0,
+        et_delta: float = 0.25,
+    ) -> tuple[np.ndarray, float, int]:
         """One phase: returns (communities in padded space, modularity, iters).
 
         Semantics of louvain.cpp:471-588: iterate until the modularity gain
         drops below `threshold`; return the assignment *before* the last two
         speculative move rounds (cvect = pastComm) and its modularity.
+
+        Early termination (cf. louvain.cpp:7-423):
+          et_mode 1/3 — freeze a vertex once target == curr == past for an
+            iteration beyond the second (the *intended* semantics of
+            louvain.cpp:172-182; the reference's chained comparison
+            `a == b == c` is a C++ accident not replicated here);
+          et_mode 2/4 — decay a per-vertex probability by (1 - et_delta)
+            whenever curr == past, freeze below P_CUTOFF
+            (louvain.cpp:378-395);
+          modes 3/4 additionally stop the whole loop once >= ET_CUTOFF of
+          all vertices are frozen (louvain.cpp:114-121; the reference
+          compares a raw count against the percentage constant — here the
+          documented 90% fraction is used).
         """
         comm = self.comm0
         past = comm
         prev_mod = lower
         iters = 0
+        et_stop = et_mode in (3, 4)
+        if et_mode:
+            active = self.real_mask_dev
+            nv_real = int(self.real_mask.sum())
+            if et_mode in (2, 4):
+                p_act = jnp.ones_like(self.vdeg)
         while True:
             iters += 1
             target, mod, _ = self._step(
                 self.src, self.dst, self.w, comm, self.vdeg, self.constant
             )
+            if et_mode:
+                target = jnp.where(active, target, comm)
             curr_mod = float(mod)
+            if et_stop:
+                frozen = nv_real - int(jnp.sum(active))
+                if frozen >= ET_CUTOFF * nv_real:
+                    break
             if (curr_mod - prev_mod) < threshold:
                 break
             prev_mod = max(curr_mod, lower)
+            if et_mode and iters > 2:
+                if et_mode in (1, 3):
+                    stable = (target == comm) & (comm == past)
+                    active = active & ~stable
+                else:
+                    decayed = active & (comm == past)
+                    p_act = jnp.where(decayed, p_act * (1.0 - et_delta), p_act)
+                    active = active & ~(decayed & (p_act <= P_CUTOFF))
             past = comm
             comm = target
             if iters >= MAX_TOTAL_ITERATIONS:
@@ -174,6 +218,8 @@ def louvain_phases(
     threshold_cycling: bool = False,
     one_phase: bool = False,
     balanced: bool = False,
+    et_mode: int = 0,
+    et_delta: float = 0.25,
     max_phases: int = TERMINATION_PHASE_COUNT,
     verbose: bool = False,
 ) -> LouvainResult:
@@ -200,9 +246,17 @@ def louvain_phases(
         th = threshold_for_phase(phase) if (threshold_cycling and not one_phase) \
             else threshold
         t1 = time.perf_counter()
-        dg = DistGraph.build(g, nshards, balanced=balanced)
+        # Shape floors: every coarsened phase small enough to fit them reuses
+        # one compiled step instead of recompiling per phase.
+        dg = DistGraph.build(
+            g, nshards, balanced=balanced,
+            min_nv_pad=max(1, 4096 // nshards),
+            min_ne_pad=max(1, 16384 // nshards),
+        )
         runner = PhaseRunner(dg, mesh=mesh)
-        comm_pad, curr_mod, iters = runner.run(th, lower=-1.0)
+        comm_pad, curr_mod, iters = runner.run(
+            th, lower=-1.0, et_mode=et_mode, et_delta=et_delta
+        )
         t2 = time.perf_counter()
         tot_iters += iters
 

@@ -1,0 +1,111 @@
+"""Parallel linear congruential generator with reference parity.
+
+Replicates the reference LCG (/root/reference/utils.hpp:76-271):
+Park-Miller MINSTD, x[i] = (16807 * x[i-1]) mod (2^31 - 1), seeded through a
+C++11 std::seed_seq of one word (utils.hpp:104-111), with the rank-0 seed
+distributed by a parallel-prefix jump so each shard owns a contiguous slice
+of ONE global stream (utils.hpp:151-189).
+
+The reference computes the per-rank jump with log2(p) rounds of 2x2 matrix
+exchanges; a closed-form modular power gives the identical values without
+communication (the matrix [[a,0],[b,1]]^k encodes x -> a^k x + b*(a^(k-1)+
+...+1); with b=0 this is a plain modpow).
+
+All parity-sensitive paths are host-side numpy (generation happens once per
+run; devices only consume the resulting coordinate arrays).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+MLCG = 2147483647  # 2^31 - 1 (utils.hpp:25)
+ALCG = 16807       # 7^5      (utils.hpp:26)
+BLCG = 0           # utils.hpp:27
+
+
+def seed_seq_generate(seeds: list[int], n: int) -> list[int]:
+    """C++11 std::seed_seq::generate ([rand.util.seedseq]) for 32-bit words."""
+    M32 = 0xFFFFFFFF
+    if n == 0:
+        return []
+    b = [0x8B8B8B8B] * n
+    s = len(seeds)
+    t = 11 if n >= 623 else 7 if n >= 68 else 5 if n >= 39 else 3 if n >= 7 \
+        else (n - 1) // 2
+    p = (n - t) // 2
+    q = p + t
+    m = max(s + 1, n)
+
+    def T(x: int) -> int:
+        return (x ^ (x >> 27)) & M32
+
+    for k in range(m):
+        r1 = (1664525 * T(b[k % n] ^ b[(k + p) % n] ^ b[(k - 1) % n])) & M32
+        if k == 0:
+            r2 = (r1 + s) & M32
+        elif k <= s:
+            r2 = (r1 + (k % n) + seeds[k - 1]) & M32
+        else:
+            r2 = (r1 + (k % n)) & M32
+        b[(k + p) % n] = (b[(k + p) % n] + r1) & M32
+        b[(k + q) % n] = (b[(k + q) % n] + r2) & M32
+        b[k % n] = r2
+    for k in range(m, m + n):
+        r3 = (1566083941 * T((b[k % n] + b[(k + p) % n] + b[(k - 1) % n]) & M32)) & M32
+        r4 = (r3 - (k % n)) & M32
+        b[(k + p) % n] ^= r3
+        b[(k + q) % n] ^= r4
+        b[k % n] = r4
+    return b
+
+
+def reseeder(initseed: int) -> int:
+    """utils.hpp:104-111: one seed_seq word from the user seed."""
+    return seed_seq_generate([initseed & 0xFFFFFFFF], 1)[0]
+
+
+def lcg_jump(x0: int, k: int) -> int:
+    """x_k given x_0: closed form of the reference's 2x2 matrix power
+    (utils.hpp:136-189).  With b=0 this is x0 * a^k mod M."""
+    a_k = pow(ALCG, k, MLCG)
+    if BLCG == 0:
+        return (x0 * a_k) % MLCG
+    # geometric series b * (a^(k-1) + ... + 1) mod M
+    geo = (a_k - 1) * pow(ALCG - 1, MLCG - 2, MLCG) % MLCG
+    return (x0 * a_k + BLCG * geo) % MLCG
+
+
+def lcg_stream(seed: int, total: int, lo: int = 0, hi: int | None = None) -> np.ndarray:
+    """Slice [lo, hi) of the global LCG stream for `seed`, as uniforms in
+    [0, 1) — matching LCG::generate's scaling (utils.hpp:216-234).
+
+    Stream convention (utils.hpp:91-98, :183-188): element 0 IS x0 (the
+    reseeded seed); element i is the i-th LCG successor.
+    """
+    hi = total if hi is None else hi
+    n = hi - lo
+    if n <= 0:
+        return np.empty(0, dtype=np.float64)
+    # Vectorized: x_{base+j} = x_base * a^j mod M.  Both factors are < 2^31,
+    # so the products fit int64 exactly.  Walk base points in blocks of B.
+    B = 1024
+    a_pows = np.empty(B, dtype=np.int64)
+    a_pows[0] = 1
+    for j in range(1, B):
+        a_pows[j] = (a_pows[j - 1] * ALCG) % MLCG
+    a_B = pow(ALCG, B, MLCG)
+    out = np.empty(n, dtype=np.int64)
+    x0 = reseeder(seed)
+    x = lcg_jump(x0, lo)
+    for b0 in range(0, n, B):
+        blk = min(B, n - b0)
+        out[b0 : b0 + blk] = (x * a_pows[:blk]) % MLCG
+        x = (x * a_B) % MLCG
+    if lo == 0:
+        # Reference quirk (utils.hpp:185-186): rank 0 uses the raw reseeded
+        # x0 without the mod, so a 32-bit x0 >= MLCG yields a uniform > 1.0.
+        # Replicated for stream parity.
+        out[0] = x0
+    mult = 1.0 / float(MLCG)  # 1/(1 + (MLCG-1)) (utils.hpp:216)
+    return out.astype(np.float64) * mult

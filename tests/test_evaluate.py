@@ -1,0 +1,113 @@
+"""Ground-truth comparison (F-score/Gini) + ET modes + CLI."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cuvite_tpu.evaluate.compare import (
+    compare_communities,
+    gini_coefficient,
+    load_ground_truth,
+    write_communities,
+)
+from cuvite_tpu.louvain.driver import louvain_phases
+
+
+def test_compare_identical_partitions():
+    c = np.array([0, 0, 1, 1, 2])
+    r = compare_communities(c, c)
+    assert r.precision == 1.0 and r.recall == 1.0 and r.f_score == 1.0
+    assert r.false_negative == 0 and r.false_positive == 0
+    # pairs: C(2,2)+C(2,2)+C(1,2) = 1+1+0
+    assert r.true_positive == 2
+
+
+def test_compare_against_brute_force():
+    rng = np.random.default_rng(0)
+    truth = rng.integers(0, 4, size=30)
+    out = rng.integers(0, 5, size=30)
+    r = compare_communities(truth, out)
+    tp = fn = fp = 0
+    for i in range(30):
+        for j in range(i + 1, 30):
+            st, so = truth[i] == truth[j], out[i] == out[j]
+            tp += st and so
+            fn += st and not so
+            fp += so and not st
+    assert (r.true_positive, r.false_negative, r.false_positive) == (tp, fn, fp)
+    assert r.precision == pytest.approx(tp / (tp + fp))
+    assert r.recall == pytest.approx(tp / (tp + fn))
+
+
+def test_gini_uniform_is_zero():
+    assert gini_coefficient(np.array([5, 5, 5, 5])) == pytest.approx(0.0)
+
+
+def test_gini_concentrated_is_high():
+    g = gini_coefficient(np.array([1, 1, 1, 97]))
+    assert g > 0.7
+
+
+def test_ground_truth_roundtrip(tmp_path):
+    p = tmp_path / "truth.dat"
+    p.write_text("0 1\n1 1\n2 2\n3 2\n")
+    c = load_ground_truth(str(p))  # 1-based by default
+    np.testing.assert_array_equal(c, [0, 0, 1, 1])
+    out = tmp_path / "out.communities"
+    write_communities(str(out), c)
+    np.testing.assert_array_equal(np.loadtxt(out, dtype=np.int64), c)
+
+
+def test_compare_report_format():
+    c = np.array([0, 0, 1, 1])
+    rep = compare_communities(c, c).report()
+    assert "F-score" in rep and "Gini" in rep and "True positive" in rep
+
+
+@pytest.mark.parametrize("mode", [1, 2, 3, 4])
+def test_et_modes_converge(karate, mode):
+    res = louvain_phases(karate, et_mode=mode, et_delta=0.25)
+    from cuvite_tpu.evaluate.modularity import modularity
+    q = modularity(karate, res.communities)
+    assert q >= 0.35, f"ET mode {mode} degraded quality: Q={q}"
+
+
+def test_cli_end_to_end(tmp_path, karate):
+    from cuvite_tpu.io.vite import write_vite
+
+    binp = tmp_path / "karate.bin"
+    write_vite(str(binp), karate, bits64=True)
+    cmd = [
+        sys.executable, "-m", "cuvite_tpu.cli",
+        "--file", str(binp), "--bits64", "--output", "--json", "--quiet",
+    ]
+    proc = subprocess.run(
+        cmd, capture_output=True, text=True, cwd=str(tmp_path),
+        env={"PYTHONPATH": "/root/repo", "PATH": "/usr/local/bin:/usr/bin:/bin",
+             "JAX_PLATFORMS": "cpu",
+             "XLA_FLAGS": "--xla_force_host_platform_device_count=1"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    summary = json.loads(line)
+    assert summary["modularity"] > 0.38
+    assert (tmp_path / "karate.bin.communities").exists()
+
+
+def test_cli_validation_errors(tmp_path):
+    from cuvite_tpu.cli import build_parser, validate
+
+    with pytest.raises(SystemExit):
+        validate(build_parser().parse_args([]))  # no input
+    with pytest.raises(SystemExit):
+        validate(build_parser().parse_args(
+            ["--generate", "64", "--one-phase", "--threshold-cycling"]))
+    with pytest.raises(SystemExit):
+        validate(build_parser().parse_args(
+            ["--generate", "64", "--coloring", "4", "--vertex-ordering", "4"]))
+    with pytest.raises(SystemExit):
+        validate(build_parser().parse_args(
+            ["--file", "x", "--random-edges", "5"]))

@@ -1,0 +1,88 @@
+"""Generator + RNG tests (LCG parity values from a compiled C++ oracle
+running the reference's reseeder/LCG, utils.hpp:76-271)."""
+
+import numpy as np
+import pytest
+
+from cuvite_tpu.io.generate import generate_rgg, generate_rmat, rgg_points, rgg_radius
+from cuvite_tpu.louvain.driver import louvain_phases
+from cuvite_tpu.utils.rng import MLCG, lcg_jump, lcg_stream, reseeder
+
+
+def test_reseeder_matches_cpp_seed_seq():
+    # std::seed_seq({1u}) / ({42u}) single-word outputs
+    assert reseeder(1) == 1967017404
+    assert reseeder(42) == 2934951935
+
+
+def test_lcg_sequence_matches_reference():
+    expected = [1967017404, 1298247110, 1205324250, 671427599,
+                1804575055, 581402804, 586332978, 1843388810]
+    s = lcg_stream(1, 8)
+    got = [int(round(v * MLCG)) for v in s]
+    assert got == expected
+
+
+def test_lcg_jump_consistent_with_stream():
+    full = lcg_stream(1, 100)
+    for lo in (0, 1, 17, 64, 99):
+        sliced = lcg_stream(1, 100, lo=lo, hi=100)
+        np.testing.assert_allclose(sliced, full[lo:], rtol=0, atol=0)
+    assert lcg_jump(reseeder(1), 5) == 581402804
+
+
+def test_rgg_points_in_strips():
+    nv, p = 1024, 4
+    x, y = rgg_points(nv, p, seed=1)
+    n = nv // p
+    assert len(x) == nv
+    for s in range(p):
+        ys = y[s * n : (s + 1) * n]
+        assert np.all(ys >= s / p) and np.all(ys < (s + 1) / p + 1e-12)
+    assert np.all((x >= 0) & (x <= 2.0))  # element 0 may exceed 1 (ref quirk)
+
+
+def test_rgg_shard_count_invariance_of_stream():
+    """The same global stream is sliced per shard: x coords of shard s for
+    p=4 equal stream slice [s*2n, s*2n+n)."""
+    nv = 256
+    x4, _ = rgg_points(nv, 4, seed=1)
+    full = lcg_stream(1, 2 * nv)
+    n = nv // 4
+    np.testing.assert_allclose(x4[:n], full[:n])
+    np.testing.assert_allclose(x4[n : 2 * n], full[2 * n : 3 * n])
+
+
+def test_rgg_graph_properties():
+    g = generate_rgg(512, nshards=2, seed=1)
+    assert g.num_vertices == 512
+    assert g.num_edges > 0
+    # weights are euclidean distances <= rn
+    assert g.weights.max() <= rgg_radius(512) + 1e-6
+    # symmetric: both directions present
+    assert g.num_edges % 2 == 0
+
+
+def test_rgg_strip_too_narrow_raises():
+    with pytest.raises(ValueError):
+        generate_rgg(128, nshards=64)
+
+
+def test_rgg_louvain_finds_structure():
+    g = generate_rgg(512, seed=1)
+    res = louvain_phases(g)
+    assert res.modularity > 0.5  # RGGs are strongly modular
+
+
+def test_rmat_shape_and_degree_skew():
+    g = generate_rmat(10, edge_factor=8, seed=3)
+    assert g.num_vertices == 1024
+    deg = g.degrees()
+    # power-lawish: max degree far above mean
+    assert deg.max() > 4 * deg.mean()
+
+
+def test_rmat_deterministic():
+    g1 = generate_rmat(8, seed=7)
+    g2 = generate_rmat(8, seed=7)
+    np.testing.assert_array_equal(g1.tails, g2.tails)
