@@ -1,0 +1,369 @@
+"""Degree-bucketed Louvain step: the TPU analog of the reference GPU's
+degree-class specialization.
+
+The reference partitions vertices into three degree classes and runs a
+different CUDA kernel per class (count_size_clmap,
+/root/reference/louvain_cuda.cu:1426-1592; distGetMaxIndex variants
+:878-1346; computeMaxIndex variants :230-876).  The equivalent TPU-first
+move: bucket vertices by degree into FIXED-WIDTH padded rows
+[n_bucket, D] whose edge gather indices are computed once per phase
+(static shapes, one compile), and do the neighbor-community dedup +
+gain + argmax as dense row-local ops that XLA fuses — no per-iteration
+global sort, no hash maps.
+
+Per row of width D the dedup is the O(D^2) all-pairs compare
+(eq[j,k] = C[j]==C[k]); cheap for D <= ~64 and perfectly vectorized.
+Vertices with degree > the largest bucket width go down the sort-based
+path (cuvite_tpu/louvain/step.py machinery) restricted to THEIR edges
+only — the analog of the reference's "huge" class using a different
+algorithm entirely (dense scratch bincount, louvain_cuda.cu:878-1022).
+
+Orchestration (what is static per phase vs dynamic per iteration):
+
+  static per phase:  bucket membership, per-row dst/weight matrices,
+                     per-vertex self-loop weight, heavy-edge subset
+  per iteration:     one gather of comm[dst] per bucket, row-local
+                     dedup/gain/argmax, community size/degree refresh
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cuvite_tpu.ops import segment as seg
+
+DEFAULT_BUCKETS = (8, 32, 128, 512, 2048, 8192)
+QUADRATIC_MAX_WIDTH = 32   # all-pairs dedup for narrow rows; row-sort above
+ROW_CHUNK = 8192   # rows per lax.map step to bound [chunk, D, D]
+ROW_ELEMS_CHUNK = 1 << 22  # rows*width per lax.map step for sorted dedup
+
+
+def chunk_for_width(width: int) -> int:
+    """Rows per lax.map step — shared by the plan builder (row padding) and
+    the step (chunk dispatch); a mismatch would silently disable chunking."""
+    if width <= QUADRATIC_MAX_WIDTH:
+        return ROW_CHUNK
+    return max(ROW_ELEMS_CHUNK // width, 1)
+
+
+@dataclasses.dataclass
+class Bucket:
+    width: int
+    verts: np.ndarray    # [Nb] local vertex indices
+    dst: np.ndarray      # [Nb, D] GLOBAL (padded-space) tail ids; pad -> self
+    w: np.ndarray        # [Nb, D] weights; pad -> 0
+
+
+@dataclasses.dataclass
+class BucketPlan:
+    """Phase-static layout for one shard's edge slab."""
+
+    nv_local: int
+    buckets: list            # list[Bucket]
+    heavy_src: np.ndarray    # [NEh_pad] local src idx of heavy edges (pad nv)
+    heavy_dst: np.ndarray    # [NEh_pad] global tail ids (pad 0)
+    heavy_w: np.ndarray      # [NEh_pad] weights (pad 0)
+    self_loop: np.ndarray    # [nv_local] per-vertex self-loop weight
+    has_heavy: bool
+
+    @staticmethod
+    def build(
+        src: np.ndarray,
+        dst: np.ndarray,
+        w: np.ndarray,
+        nv_local: int,
+        base: int,
+        widths: tuple = DEFAULT_BUCKETS,
+    ) -> "BucketPlan":
+        """`src` holds local indices (pad = nv_local); `dst` global padded
+        ids; `base` is this shard's first global id (for self-loop
+        detection)."""
+        real = src < nv_local
+        s = src[real].astype(np.int64)
+        d = dst[real].astype(np.int64)
+        ww = w[real].astype(np.float64)
+        deg = np.bincount(s, minlength=nv_local)
+        order = np.argsort(s, kind="stable")
+        s, d, ww = s[order], d[order], ww[order]
+        row_start = np.concatenate([[0], np.cumsum(deg)[:-1]]).astype(np.int64)
+
+        self_loop = np.zeros(nv_local, dtype=np.float64)
+        is_self = d == (s + base)
+        np.add.at(self_loop, s[is_self], ww[is_self])
+
+        buckets = []
+        prev = 0
+        for width in widths:
+            sel = np.nonzero((deg > prev) & (deg <= width))[0]
+            prev = width
+            if len(sel) == 0:
+                continue
+            nb = len(sel)
+            # Pad the row count so lax.map can chunk evenly; padding rows
+            # use local index nv_local (dropped by out-of-bounds scatter).
+            chunk = chunk_for_width(width)
+            nb_pad = nb if nb <= chunk else int(chunk * np.ceil(nb / chunk))
+            verts = np.full(nb_pad, nv_local, dtype=np.int64)
+            verts[:nb] = sel
+            dmat = np.zeros((nb_pad, width), dtype=dst.dtype)
+            wmat = np.zeros((nb_pad, width), dtype=w.dtype)
+            # One vectorized gather per bucket; column padding uses the
+            # vertex's own global id with weight 0 (a zero-weight self-edge
+            # never becomes a candidate and adds 0 to counter0).
+            cols = np.arange(width)
+            idx = row_start[sel][:, None] + cols[None, :]
+            has = cols[None, :] < deg[sel][:, None]
+            idx = np.minimum(idx, max(len(d) - 1, 0))
+            dmat[:nb] = np.where(has, d[idx], (sel + base)[:, None])
+            wmat[:nb] = np.where(has, ww[idx], 0.0)
+            buckets.append(Bucket(width=width, verts=verts, dst=dmat, w=wmat))
+
+        heavy_v = np.nonzero(deg > widths[-1])[0]
+        if len(heavy_v):
+            hmask = np.isin(s, heavy_v)
+            hs, hd, hw = s[hmask], d[hmask], ww[hmask]
+            n = len(hs)
+            npad = max(int(2 ** np.ceil(np.log2(max(n, 1)))), 8)
+            heavy_src = np.full(npad, nv_local, dtype=src.dtype)
+            heavy_dst = np.zeros(npad, dtype=dst.dtype)
+            heavy_w = np.zeros(npad, dtype=w.dtype)
+            heavy_src[:n] = hs
+            heavy_dst[:n] = hd
+            heavy_w[:n] = hw
+            has_heavy = True
+        else:
+            heavy_src = np.full(8, nv_local, dtype=src.dtype)
+            heavy_dst = np.zeros(8, dtype=dst.dtype)
+            heavy_w = np.zeros(8, dtype=w.dtype)
+            has_heavy = False
+        return BucketPlan(
+            nv_local=nv_local,
+            buckets=buckets,
+            heavy_src=heavy_src,
+            heavy_dst=heavy_dst,
+            heavy_w=heavy_w,
+            self_loop=self_loop.astype(w.dtype),
+            has_heavy=has_heavy,
+        )
+
+
+class RowResult(NamedTuple):
+    best_c: jax.Array    # [Nb] best candidate community (sentinel if none)
+    best_gain: jax.Array  # [Nb]
+    counter0: jax.Array  # [Nb] weight to current community (incl self-loops)
+
+
+def _row_argmax(cmat, wmat, curr_comm, vdeg_v, eix_v, comm_deg, constant,
+                sentinel):
+    """Dedup + dQ + argmax for one chunk of bucket rows.
+
+    cmat [T, D] neighbor communities; wmat [T, D] weights; the rest [T].
+    Replicates distGetMaxIndex (/root/reference/louvain.cpp:2185-2244):
+    gain = 2*(e_iy - e_ix) - 2*k_i*(a_y - a_x)/2m, ties to smaller id.
+    """
+    wdt = wmat.dtype
+    # all-pairs equality within the row: eq[t, j, k] = C[j] == C[k]
+    eq = cmat[:, :, None] == cmat[:, None, :]
+    # aggregated weight per slot: sum over duplicates
+    wagg = jnp.einsum("tjk,tk->tj", eq.astype(wdt), wmat)
+    # leader slot = first occurrence of its community
+    tri = jnp.tril(jnp.ones((cmat.shape[1], cmat.shape[1]), dtype=bool), k=-1)
+    dup = jnp.any(eq & tri[None, :, :], axis=2)
+    is_cc = cmat == curr_comm[:, None]
+    counter0 = jnp.sum(jnp.where(is_cc, wmat, 0.0), axis=1)
+    valid = (~dup) & (~is_cc) & (wmat > 0)
+
+    a_y = jnp.take(comm_deg, cmat)
+    a_x = (jnp.take(comm_deg, curr_comm) - vdeg_v)[:, None]
+    gain = 2.0 * (wagg - eix_v[:, None]) \
+        - 2.0 * vdeg_v[:, None] * (a_y - a_x) * constant
+    neg_inf = jnp.array(-jnp.inf, dtype=wdt)
+    gain = jnp.where(valid, gain, neg_inf)
+    best_gain = jnp.max(gain, axis=1)
+    at_best = valid & (gain == best_gain[:, None])
+    best_c = jnp.min(
+        jnp.where(at_best, cmat, jnp.full_like(cmat, sentinel)), axis=1
+    )
+    return RowResult(best_c=best_c, best_gain=best_gain, counter0=counter0)
+
+
+def _row_argmax_sorted(cmat, wmat, curr_comm, vdeg_v, eix_v, comm_deg,
+                       constant, sentinel):
+    """Dedup + dQ + argmax for wide rows via a per-row sort.
+
+    O(D log^2 D) per row instead of the all-pairs O(D^2): sort each row by
+    community id, detect runs, and compute run sums with a reverse cumsum +
+    next-leader index (reverse cummin) — all lane-parallel scans.  This is
+    the TPU counterpart of the reference's medium/large GPU kernels
+    (/root/reference/louvain_cuda.cu:1024-1346).
+    """
+    wdt = wmat.dtype
+    D = cmat.shape[1]
+    c_s, w_s = jax.lax.sort((cmat, wmat), dimension=1, num_keys=1)
+    leader = jnp.concatenate(
+        [jnp.ones_like(c_s[:, :1], dtype=bool), c_s[:, 1:] != c_s[:, :-1]],
+        axis=1,
+    )
+    pos = jax.lax.broadcasted_iota(jnp.int32, c_s.shape, 1)
+    leaderpos = jnp.where(leader, pos, D)
+    # next leader strictly to the right of j (D if none)
+    nxt = jnp.flip(jax.lax.cummin(jnp.flip(leaderpos, 1), axis=1), 1)
+    nxt = jnp.concatenate(
+        [nxt[:, 1:], jnp.full_like(nxt[:, :1], D)], axis=1
+    )
+    # suffix sums S[j] = sum_{k >= j} w; S_ext has trailing 0 column
+    suf = jnp.flip(jnp.cumsum(jnp.flip(w_s, 1), axis=1), 1)
+    suf_ext = jnp.concatenate([suf, jnp.zeros_like(suf[:, :1])], axis=1)
+    run_sum = suf - jnp.take_along_axis(suf_ext, nxt, axis=1)
+
+    is_cc = c_s == curr_comm[:, None]
+    counter0 = jnp.sum(jnp.where(is_cc, w_s, 0.0), axis=1).astype(wdt)
+    valid = leader & (~is_cc) & (w_s > 0)
+
+    a_y = jnp.take(comm_deg, c_s)
+    a_x = (jnp.take(comm_deg, curr_comm) - vdeg_v)[:, None]
+    gain = 2.0 * (run_sum - eix_v[:, None]) \
+        - 2.0 * vdeg_v[:, None] * (a_y - a_x) * constant
+    neg_inf = jnp.array(-jnp.inf, dtype=wdt)
+    gain = jnp.where(valid, gain, neg_inf)
+    best_gain = jnp.max(gain, axis=1)
+    at_best = valid & (gain == best_gain[:, None])
+    best_c = jnp.min(
+        jnp.where(at_best, c_s, jnp.full_like(c_s, sentinel)), axis=1
+    )
+    return RowResult(best_c=best_c, best_gain=best_gain, counter0=counter0)
+
+
+def _rows_chunked(cmat, w_mat, curr, vdeg_v, eix_v, comm_deg, constant,
+                  sentinel):
+    """Dispatch rows to the right dedup variant, chunked with lax.map to
+    bound intermediate memory."""
+    nb, width = cmat.shape
+    kernel = (_row_argmax if width <= QUADRATIC_MAX_WIDTH
+              else _row_argmax_sorted)
+    chunk = chunk_for_width(width)
+    if nb <= chunk or nb % chunk != 0:
+        return kernel(cmat, w_mat, curr, vdeg_v, eix_v, comm_deg,
+                      constant, sentinel)
+    nchunk = nb // chunk
+
+    def f(args):
+        return kernel(*args, comm_deg, constant, sentinel)
+
+    res = jax.lax.map(
+        f,
+        (
+            cmat.reshape(nchunk, chunk, -1),
+            w_mat.reshape(nchunk, chunk, -1),
+            curr.reshape(nchunk, chunk),
+            vdeg_v.reshape(nchunk, chunk),
+            eix_v.reshape(nchunk, chunk),
+        ),
+    )
+    return RowResult(
+        best_c=res.best_c.reshape(nb),
+        best_gain=res.best_gain.reshape(nb),
+        counter0=res.counter0.reshape(nb),
+    )
+
+
+def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
+                  constant, *, nv_total, sentinel, accum_dtype=None):
+    """Full single-shard Louvain sweep using the bucketed engine.
+
+    ``bucket_arrays`` is a tuple of (verts, dst_mat, w_mat) triples (one per
+    degree class); ``heavy_arrays`` is (src, dst, w) for the residual
+    heavy-vertex edges (may be empty-padded).  Returns (target, modularity,
+    n_moved) with semantics identical to louvain_step_local — the two
+    engines are interchangeable and tested for equal outputs.
+    """
+    nv_local = comm.shape[0]
+    wdt = vdeg.dtype
+    vdt = comm.dtype
+
+    comm_deg = seg.segment_sum(vdeg, comm, num_segments=nv_total)
+    comm_size = seg.segment_sum(
+        jnp.ones((nv_local,), dtype=vdt), comm, num_segments=nv_total
+    )
+
+    # Per-vertex weight into the current community (incl. self-loops) comes
+    # out of the bucket pass; start from zero and accumulate per class.
+    counter0 = jnp.zeros((nv_local,), dtype=wdt)
+    best_c = jnp.full((nv_local,), sentinel, dtype=vdt)
+    neg_inf = jnp.array(-jnp.inf, dtype=wdt)
+    best_gain = jnp.full((nv_local,), neg_inf, dtype=wdt)
+
+    # eix depends on counter0 which the buckets themselves produce; the gain
+    # formula needs it per ROW, so compute counter0 first (cheap masked sums)
+    # then run the argmax passes.  For bucket rows counter0 is row-local;
+    # compute it inline per bucket and assemble.
+    hs, hd, hw = heavy_arrays
+    ckey_h = jnp.take(comm, hd)
+    csrc_h = jnp.take(comm, jnp.minimum(hs, nv_local - 1))
+    c0_heavy = seg.segment_sum(
+        jnp.where(ckey_h == csrc_h, hw, jnp.zeros_like(hw)), hs,
+        num_segments=nv_local,
+    )
+    counter0 = counter0 + c0_heavy
+    # bucket counter0 values are produced by the row pass below.
+
+    row_results = []
+    for verts, dst_mat, w_mat in bucket_arrays:
+        cmat = jnp.take(comm, dst_mat)
+        curr = jnp.take(comm, jnp.minimum(verts, nv_local - 1))
+        c0_rows = jnp.sum(
+            jnp.where(cmat == curr[:, None], w_mat, 0.0), axis=1
+        ).astype(wdt)
+        counter0 = counter0.at[verts].add(c0_rows, mode="drop")
+        row_results.append((verts, cmat, w_mat, curr))
+    eix = counter0 - self_loop
+
+    for verts, cmat, w_mat, curr in row_results:
+        safe_v = jnp.minimum(verts, nv_local - 1)
+        res = _rows_chunked(cmat, w_mat, curr,
+                            jnp.take(vdeg, safe_v), jnp.take(eix, safe_v),
+                            comm_deg, constant, sentinel)
+        best_c = best_c.at[verts].set(res.best_c, mode="drop")
+        best_gain = best_gain.at[verts].set(res.best_gain, mode="drop")
+
+    # ---- heavy vertices: sort-based candidates on their edges only -------
+    src_s, ckey_s, w_s = seg.sort_edges_by_vertex_comm(hs, ckey_h, hw)
+    starts = seg.run_starts(src_s, ckey_s)
+    eiy, _ = seg.run_totals(w_s, starts)
+    i_s = jnp.minimum(src_s, nv_local - 1)
+    comm_i = jnp.take(comm, i_s)
+    valid = starts & (src_s < nv_local) & (ckey_s != comm_i)
+    k_i = jnp.take(vdeg, i_s)
+    a_y = jnp.take(comm_deg, ckey_s)
+    a_x = jnp.take(comm_deg, comm_i) - k_i
+    gain = 2.0 * (eiy - jnp.take(eix, i_s)) - 2.0 * k_i * (a_y - a_x) * constant
+    gain = jnp.where(valid, gain, neg_inf)
+    hg = seg.segment_max(gain, src_s, num_segments=nv_local, sorted_ids=True)
+    at_best = valid & (gain == jnp.take(hg, i_s))
+    cand_c = jnp.where(at_best, ckey_s, jnp.full_like(ckey_s, sentinel))
+    hc = seg.segment_min(cand_c, src_s, num_segments=nv_local, sorted_ids=True)
+    heavy_better = hg > best_gain
+    best_gain = jnp.where(heavy_better, hg, best_gain)
+    best_c = jnp.where(heavy_better, hc, best_c)
+
+    # ---- select + singleton guard (louvain.cpp:2230-2241) ----------------
+    move = best_gain > 0.0
+    best_c_safe = jnp.minimum(best_c, jnp.array(nv_total - 1, dtype=vdt))
+    t_size = jnp.take(comm_size, best_c_safe)
+    c_size = jnp.take(comm_size, comm)
+    guard = (t_size == 1) & (c_size == 1) & (best_c_safe > comm)
+    move = move & ~guard
+    target = jnp.where(move, best_c_safe, comm)
+
+    acc = wdt if accum_dtype is None else accum_dtype
+    le_xx = jnp.sum(counter0.astype(acc))
+    la2_x = jnp.sum(jnp.square(comm_deg.astype(acc)))
+    c_acc = constant.astype(acc)
+    modularity = le_xx * c_acc - la2_x * c_acc * c_acc
+    n_moved = jnp.sum(move.astype(jnp.int32))
+    return target, modularity, n_moved

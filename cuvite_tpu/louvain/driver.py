@@ -19,6 +19,7 @@ jitted step:
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 
 import jax
@@ -35,6 +36,7 @@ from cuvite_tpu.core.types import (
     P_CUTOFF,
     TERMINATION_PHASE_COUNT,
 )
+from cuvite_tpu.louvain.bucketed import BucketPlan, bucketed_step
 from cuvite_tpu.louvain.step import make_sharded_step, make_single_step
 
 
@@ -108,36 +110,93 @@ def _get_step(mesh, nv_total: int, accum_dtype) -> object:
     return step
 
 
-class PhaseRunner:
-    """Runs the iteration loop of one phase on a device mesh."""
+@functools.partial(
+    jax.jit, static_argnames=("nv_total", "sentinel", "accum_dtype")
+)
+def _bucketed_jit(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
+                  constant, *, nv_total, sentinel, accum_dtype):
+    return bucketed_step(
+        bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant,
+        nv_total=nv_total, sentinel=sentinel, accum_dtype=accum_dtype,
+    )
 
-    def __init__(self, dg: DistGraph, mesh=None):
+
+class PhaseRunner:
+    """Runs the iteration loop of one phase on a device mesh.
+
+    ``engine``: 'sort' — the edge-slab sort/segment step (works single and
+    multi-shard); 'bucketed' — the degree-bucketed engine (single-shard for
+    now), the analog of the reference GPU's degree-class kernels.
+    """
+
+    def __init__(self, dg: DistGraph, mesh=None, engine: str = "sort"):
+        if engine not in ("sort", "bucketed"):
+            raise ValueError(f"unknown engine {engine!r}; use 'sort' or "
+                             "'bucketed' ('auto' is resolved by "
+                             "louvain_phases)")
         self.dg = dg
         self.mesh = mesh
+        self.engine = engine
         nv_total = dg.total_padded_vertices
-        src, dst, w = dg.stacked_edges()
         vdeg = dg.padded_weighted_degrees()
         vdt = _device_dtype(dg.graph.policy.vertex_dtype)
         wdt = _device_dtype(dg.graph.policy.weight_dtype)
-        src, dst = src.astype(vdt), dst.astype(vdt)
-        w, vdeg = w.astype(wdt), vdeg.astype(wdt)
+        vdeg = vdeg.astype(wdt)
         comm0 = np.arange(nv_total, dtype=vdt)
         adt = _device_dtype(dg.graph.policy.accum_dtype)
-        self._step = _get_step(mesh, nv_total, adt)
+        multi = mesh is not None and int(np.prod(mesh.devices.shape)) > 1
+        if engine == "bucketed" and multi:
+            raise NotImplementedError(
+                "bucketed engine is single-shard for now; use engine='sort'"
+            )
+        if engine == "bucketed":
+            # The bucket matrices replace the edge slab entirely: don't
+            # upload src/dst/w (they would double edge memory on device).
+            sh = dg.shards[0]
+            plan = BucketPlan.build(
+                np.asarray(sh.src), np.asarray(sh.dst), np.asarray(sh.w),
+                nv_local=dg.nv_pad, base=0,
+            )
+            sentinel = int(np.iinfo(vdt).max)
+            buckets = tuple(
+                (jnp.asarray(b.verts.astype(vdt)),
+                 jnp.asarray(b.dst.astype(vdt)),
+                 jnp.asarray(b.w.astype(wdt)))
+                for b in plan.buckets
+            )
+            heavy = (jnp.asarray(plan.heavy_src.astype(vdt)),
+                     jnp.asarray(plan.heavy_dst.astype(vdt)),
+                     jnp.asarray(plan.heavy_w.astype(wdt)))
+            self_loop = jnp.asarray(plan.self_loop.astype(wdt))
+            adt_np = np.dtype(adt).name
+
+            def _step(src_, dst_, w_, comm, vdeg_, constant):
+                return _bucketed_jit(
+                    buckets, heavy, self_loop, comm, vdeg_, constant,
+                    nv_total=nv_total, sentinel=sentinel, accum_dtype=adt_np,
+                )
+
+            self._step = _step
+            self.src = self.dst = self.w = None
+        else:
+            self._step = _get_step(mesh, nv_total, adt)
         self.real_mask = dg.vertex_mask()
-        if mesh is not None and np.prod(mesh.devices.shape) > 1:
+        if multi:
             assert dg.nshards == int(np.prod(mesh.devices.shape))
-            self.src = shard_1d(mesh, src)
-            self.dst = shard_1d(mesh, dst)
-            self.w = shard_1d(mesh, w)
+            src, dst, w = dg.stacked_edges()
+            self.src = shard_1d(mesh, src.astype(vdt))
+            self.dst = shard_1d(mesh, dst.astype(vdt))
+            self.w = shard_1d(mesh, w.astype(wdt))
             self.vdeg = shard_1d(mesh, vdeg)
             self.comm0 = shard_1d(mesh, comm0)
             self.real_mask_dev = shard_1d(mesh, self.real_mask)
         else:
             assert dg.nshards == 1
-            self.src = jnp.asarray(src)
-            self.dst = jnp.asarray(dst)
-            self.w = jnp.asarray(w)
+            if engine != "bucketed":
+                src, dst, w = dg.stacked_edges()
+                self.src = jnp.asarray(src.astype(vdt))
+                self.dst = jnp.asarray(dst.astype(vdt))
+                self.w = jnp.asarray(w.astype(wdt))
             self.vdeg = jnp.asarray(vdeg)
             self.comm0 = jnp.asarray(comm0)
             self.real_mask_dev = jnp.asarray(self.real_mask)
@@ -220,12 +279,18 @@ def louvain_phases(
     balanced: bool = False,
     et_mode: int = 0,
     et_delta: float = 0.25,
+    engine: str = "auto",
     max_phases: int = TERMINATION_PHASE_COUNT,
     verbose: bool = False,
 ) -> LouvainResult:
-    """Full multi-phase Louvain (the main.cpp:218-495 loop)."""
+    """Full multi-phase Louvain (the main.cpp:218-495 loop).
+
+    ``engine='auto'`` picks the degree-bucketed step on a single shard and
+    the sort-based step on a mesh."""
     if mesh is None and nshards > 1:
         mesh = make_mesh(nshards)
+    if engine == "auto":
+        engine = "bucketed" if nshards == 1 else "sort"
 
     nv0 = graph.num_vertices
     comm_all = np.arange(nv0, dtype=np.int64)
@@ -253,7 +318,7 @@ def louvain_phases(
             min_nv_pad=max(1, 4096 // nshards),
             min_ne_pad=max(1, 16384 // nshards),
         )
-        runner = PhaseRunner(dg, mesh=mesh)
+        runner = PhaseRunner(dg, mesh=mesh, engine=engine)
         comm_pad, curr_mod, iters = runner.run(
             th, lower=-1.0, et_mode=et_mode, et_delta=et_delta
         )
