@@ -94,10 +94,6 @@ def validate(args) -> None:
         raise SystemExit("--random-edges requires --generate")
     if args.coloring and args.vertex_ordering:
         raise SystemExit("Cannot enable both --coloring and --vertex-ordering")
-    if args.coloring or args.vertex_ordering:
-        raise SystemExit(
-            "--coloring / --vertex-ordering are not implemented yet"
-        )
     if args.one_phase and args.threshold_cycling:
         raise SystemExit("Cannot combine --one-phase with --threshold-cycling")
     if args.early_term in (2, 4) and not (0.0 <= args.et_delta <= 1.0):
@@ -151,6 +147,8 @@ def main(argv=None) -> int:
         balanced=args.balanced,
         et_mode=args.early_term or 0,
         et_delta=args.et_delta,
+        coloring=args.coloring or 0,
+        vertex_ordering=args.vertex_ordering or 0,
         verbose=not args.quiet,
     )
 

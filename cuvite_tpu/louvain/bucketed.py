@@ -104,10 +104,12 @@ class BucketPlan:
             if len(sel) == 0:
                 continue
             nb = len(sel)
-            # Pad the row count so lax.map can chunk evenly; padding rows
-            # use local index nv_local (dropped by out-of-bounds scatter).
-            chunk = chunk_for_width(width)
-            nb_pad = nb if nb <= chunk else int(chunk * np.ceil(nb / chunk))
+            # Pad the row count to the next power of two: stable shapes let
+            # successive coarsened phases reuse the compiled step (pow2 >
+            # chunk is automatically a multiple of the pow2 chunk, so
+            # lax.map chunking stays exact).  Padding rows use local index
+            # nv_local (dropped by out-of-bounds scatter).
+            nb_pad = 1 << int(nb - 1).bit_length() if nb > 1 else 1
             verts = np.full(nb_pad, nv_local, dtype=np.int64)
             verts[:nb] = sel
             dmat = np.zeros((nb_pad, width), dtype=dst.dtype)
@@ -176,7 +178,11 @@ def _row_argmax(cmat, wmat, curr_comm, vdeg_v, eix_v, comm_deg, constant,
     dup = jnp.any(eq & tri[None, :, :], axis=2)
     is_cc = cmat == curr_comm[:, None]
     counter0 = jnp.sum(jnp.where(is_cc, wmat, 0.0), axis=1)
-    valid = (~dup) & (~is_cc) & (wmat > 0)
+    # No w>0 filter: zero-weight edges are candidates exactly as in the sort
+    # engine.  Padding slots are safe without it — they point at the row's
+    # own vertex, whose community always equals curr_comm, so is_cc masks
+    # them out of the candidate set.
+    valid = (~dup) & (~is_cc)
 
     a_y = jnp.take(comm_deg, cmat)
     a_x = (jnp.take(comm_deg, curr_comm) - vdeg_v)[:, None]
@@ -223,7 +229,8 @@ def _row_argmax_sorted(cmat, wmat, curr_comm, vdeg_v, eix_v, comm_deg,
 
     is_cc = c_s == curr_comm[:, None]
     counter0 = jnp.sum(jnp.where(is_cc, w_s, 0.0), axis=1).astype(wdt)
-    valid = leader & (~is_cc) & (w_s > 0)
+    # No w>0 filter — see _row_argmax; padding self-slots are is_cc-masked.
+    valid = leader & (~is_cc)
 
     a_y = jnp.take(comm_deg, c_s)
     a_x = (jnp.take(comm_deg, curr_comm) - vdeg_v)[:, None]

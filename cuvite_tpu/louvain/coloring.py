@@ -1,0 +1,161 @@
+"""Distance-1 coloring via speculative multi-hash min/max.
+
+Equivalent of distColoringMultiHashMinMax (/root/reference/coloring.cpp:3-72):
+each round evaluates nHash hash functions; an uncolored vertex that is the
+strict minimum (resp. maximum) of hash t among its uncolored neighbors takes
+color 2t+nextColor (resp. 2t+1+nextColor); among multiple surviving slots the
+pick is the deterministic (v mod possible) walk (coloring.cpp:171-197).
+Rounds repeat with nextColor += 2*nHash until >= target_percent of vertices
+are colored or a round makes no progress (coloring.cpp:41-58).
+
+Conflict-freedom is by construction: "<=" / ">=" comparisons mean a hash tie
+removes BOTH directions, so two adjacent uncolored vertices can never both
+stay min (or both max) for the same hash.  distCheckColoring
+(coloring.cpp:447-593) is replicated as `count_conflicts` and used in tests.
+
+TPU-first formulation: the per-round work is one jitted edge-parallel pass —
+hashes are vectorized uint32 arithmetic, the per-(vertex, hash) min/max
+eliminations are segment reductions, and the deterministic slot walk is a
+row cumsum over the [nv, 2*nHash] availability matrix.  No per-vertex loops,
+no ghost sets: the sharded variant gathers the replicated color vector the
+same way the Louvain step gathers communities.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cuvite_tpu.ops import segment as seg
+
+UNCOLORED = -1
+MAX_COVG = 70  # default target coverage percent (main.cpp:26)
+
+
+def jenkins_mix(a, seed):
+    """The reference's 32-bit integer mix (coloring.cpp:74-85), vectorized.
+
+    Works on uint32 arrays; `seed` may be scalar or array.
+    """
+    u32 = jnp.uint32
+    a = a.astype(u32) ^ jnp.asarray(seed, dtype=u32)
+    a = (a + jnp.uint32(0x7ED55D16)) + (a << 12)
+    a = (a ^ jnp.uint32(0xC761C23C)) + (a >> 19)
+    a = (a + jnp.uint32(0x165667B1)) + (a << 5)
+    a = (a ^ jnp.uint32(0xD3A2646C)) + (a << 9)
+    a = (a + jnp.uint32(0xFD7046C5)) + (a << 3)
+    a = (a ^ jnp.uint32(0xB55A4F09)) + (a >> 16)
+    return a
+
+
+def jenkins_mix_host(a: int, seed: int) -> int:
+    """Host scalar version for the round-seed chain (seed = hash(seed, 0))."""
+    M = 0xFFFFFFFF
+    a = (a ^ seed) & M
+    a = ((a + 0x7ED55D16) + (a << 12)) & M
+    a = ((a ^ 0xC761C23C) + (a >> 19)) & M
+    a = ((a + 0x165667B1) + (a << 5)) & M
+    a = ((a ^ 0xD3A2646C) + (a << 9)) & M
+    a = ((a + 0xFD7046C5) + (a << 3)) & M
+    a = ((a ^ 0xB55A4F09) + (a >> 16)) & M
+    return a
+
+
+@functools.partial(jax.jit, static_argnames=("n_hash", "nv"))
+def _coloring_round(src, dst, color, seed, next_color, *, n_hash, nv):
+    """One speculative round. `src` local idx (pad >= nv), `dst` global ids
+    (single-shard: global == local), `color` [nv] int32."""
+    src_c = jnp.minimum(src, nv - 1)
+    src_global = src  # single-shard: local == global ids
+    uncolored_v = color == UNCOLORED
+    neigh_color = jnp.take(color, dst)
+    # participate: real edge, not a self-loop, neighbor not colored in a
+    # previous round (coloring.cpp:122-145)
+    participates = (src < nv) & (dst != src_global) & (neigh_color == UNCOLORED)
+
+    not_min = []
+    not_max = []
+    for t in range(n_hash):
+        hseed = seed + jnp.uint32(1043 * t)
+        v_hash = jenkins_mix(src_global.astype(jnp.uint32), hseed)
+        j_hash = jenkins_mix(dst.astype(jnp.uint32), hseed)
+        # eliminations (coloring.cpp:152-161); ties kill both directions
+        nm = participates & (v_hash <= j_hash)
+        nn = participates & (v_hash >= j_hash)
+        not_max.append(
+            seg.segment_max(nm.astype(jnp.int32), src_c, num_segments=nv,
+                            sorted_ids=True) > 0)
+        not_min.append(
+            seg.segment_max(nn.astype(jnp.int32), src_c, num_segments=nv,
+                            sorted_ids=True) > 0)
+
+    # availability slots interleaved [min_0, max_0, min_1, max_1, ...]
+    # (the color value IS the slot index + next_color, coloring.cpp:180,188)
+    avail = jnp.stack(
+        [m for pair in zip(not_min, not_max) for m in pair], axis=1
+    )
+    avail = ~avail & uncolored_v[:, None]
+    possible = jnp.sum(avail.astype(jnp.int32), axis=1)
+    can_color = uncolored_v & (possible > 0)
+
+    col_id = jnp.where(
+        can_color,
+        jnp.arange(nv, dtype=jnp.int32) % jnp.maximum(possible, 1),
+        0,
+    )
+    rank = jnp.cumsum(avail.astype(jnp.int32), axis=1) - 1
+    pick = avail & (rank == col_id[:, None])
+    slot = jnp.argmax(pick, axis=1).astype(jnp.int32)
+    new_color = jnp.where(can_color, slot + next_color, color)
+    return new_color, jnp.sum((new_color != UNCOLORED).astype(jnp.int32))
+
+
+def multi_hash_coloring(
+    src: np.ndarray,
+    dst: np.ndarray,
+    nv: int,
+    n_hash: int = 4,
+    target_percent: int = MAX_COVG,
+    single_iteration: bool = False,
+    seed: int = 1012,
+) -> tuple[np.ndarray, int]:
+    """Color vertices; returns (colors [nv] with -1 for uncolored,
+    num_colors upper bound = final nextColor).
+
+    Matches the reference's round loop (coloring.cpp:41-58): stop at
+    >= target_percent colored, on no progress, or after one round when
+    ``single_iteration``.
+    """
+    color = jnp.full((nv,), UNCOLORED, dtype=jnp.int32)
+    src_j = jnp.asarray(src)
+    dst_j = jnp.asarray(dst)
+    next_color = 0
+    target = (nv * target_percent) // 100
+    last = 0
+    while True:
+        color, count = _coloring_round(
+            src_j, dst_j, color, jnp.uint32(seed),
+            jnp.int32(next_color), n_hash=n_hash, nv=nv,
+        )
+        count = int(count)
+        next_color += 2 * n_hash
+        if single_iteration or count >= target or count == last:
+            break
+        seed = jenkins_mix_host(seed, 0)
+        last = count
+    return np.asarray(color), next_color
+
+
+def count_conflicts(src, dst, nv, colors) -> int:
+    """Distributed conflict checker analog (coloring.cpp:447-593): number of
+    non-self edges whose endpoints share a color != -1."""
+    src = np.asarray(src)
+    dst = np.asarray(dst)
+    colors = np.asarray(colors)
+    real = (src < nv) & (dst != src)
+    cs = colors[np.minimum(src, nv - 1)]
+    cd = colors[dst]
+    return int(np.sum(real & (cs == cd) & (cs != UNCOLORED)))

@@ -209,6 +209,8 @@ class PhaseRunner:
         lower: float,
         et_mode: int = 0,
         et_delta: float = 0.25,
+        color_classes=None,
+        n_color_classes: int = 0,
     ) -> tuple[np.ndarray, float, int]:
         """One phase: returns (communities in padded space, modularity, iters).
 
@@ -228,6 +230,17 @@ class PhaseRunner:
           all vertices are frozen (louvain.cpp:114-121; the reference
           compares a raw count against the percentage constant — here the
           documented 90% fraction is used).
+
+        Coloring (cf. distLouvainMethodWithColoring, louvain.cpp:756-949):
+        when ``color_classes`` (device array, padded id space, class index
+        per vertex) is given, each iteration sweeps the color classes in
+        order, committing each class's moves before the next class computes
+        — the speculative-parallelism schedule that turns the greedy
+        sequential sweep into n_color_classes synchronized sub-sweeps.
+        Cost note: each sub-sweep currently evaluates the full-graph step
+        and keeps only class c's moves, so an iteration costs
+        n_color_classes full sweeps (typically fewer iterations in
+        exchange); per-class bucket subsets are the planned optimization.
         """
         comm = self.comm0
         past = comm
@@ -241,10 +254,30 @@ class PhaseRunner:
                 p_act = jnp.ones_like(self.vdeg)
         while True:
             iters += 1
-            target, mod, _ = self._step(
-                self.src, self.dst, self.w, comm, self.vdeg, self.constant
-            )
-            if et_mode:
+            if color_classes is None:
+                target, mod, _ = self._step(
+                    self.src, self.dst, self.w, comm, self.vdeg, self.constant
+                )
+            else:
+                # Color-class sweep: class c's moves are visible to class
+                # c+1 within the same iteration (louvain.cpp:862-901).
+                # Frozen (inactive) vertices must never enter `work`, or
+                # later classes would decide against phantom state.
+                work = comm
+                mod = None
+                for c in range(n_color_classes):
+                    tgt_c, mod_c, _ = self._step(
+                        self.src, self.dst, self.w, work, self.vdeg,
+                        self.constant,
+                    )
+                    if mod is None:
+                        mod = mod_c  # modularity of the iteration's input
+                    mask = color_classes == c
+                    if et_mode:
+                        mask = mask & active
+                    work = jnp.where(mask, tgt_c, work)
+                target = work
+            if et_mode and color_classes is None:
                 target = jnp.where(active, target, comm)
             curr_mod = float(mod)
             if et_stop:
@@ -280,13 +313,23 @@ def louvain_phases(
     et_mode: int = 0,
     et_delta: float = 0.25,
     engine: str = "auto",
+    coloring: int = 0,
+    vertex_ordering: int = 0,
     max_phases: int = TERMINATION_PHASE_COUNT,
     verbose: bool = False,
 ) -> LouvainResult:
     """Full multi-phase Louvain (the main.cpp:218-495 loop).
 
     ``engine='auto'`` picks the degree-bucketed step on a single shard and
-    the sort-based step on a mesh."""
+    the sort-based step on a mesh.
+
+    ``coloring=N`` (reference -c N): distance-1 color the phase-0 graph with
+    N/2 hash functions and run the per-color sub-sweep schedule
+    (main.cpp:243-283).  ``vertex_ordering=N`` (reference -d N): compute the
+    same coloring but use it only to order the sequential sweep
+    (louvain.cpp:1535-1562) — under this framework's synchronous-step
+    semantics ordering has no effect, so it runs the plain schedule; the
+    coloring is still computed and reported for parity."""
     if mesh is None and nshards > 1:
         mesh = make_mesh(nshards)
     if engine == "auto":
@@ -318,9 +361,41 @@ def louvain_phases(
             min_nv_pad=max(1, 4096 // nshards),
             min_ne_pad=max(1, 16384 // nshards),
         )
+        color_dev = None
+        n_classes = 0
+        if (coloring or vertex_ordering) and phase == 0:
+            from cuvite_tpu.louvain.coloring import multi_hash_coloring
+
+            n_hash = max((coloring or vertex_ordering) // 2, 1)
+            colors, n_colors = multi_hash_coloring(
+                g.sources().astype(np.int32),
+                g.tails.astype(np.int32),
+                g.num_vertices,
+                n_hash=n_hash,
+            )
+            if verbose:
+                print(f"Number of colors (2*nHash rounds): {n_colors}, "
+                      f"colored {int((colors >= 0).sum())}/{g.num_vertices}")
+            if coloring:
+                # Compress to dense class ids (order preserved); uncolored
+                # vertices form the last class (the reference passes
+                # numColors+1 classes, main.cpp:259).
+                used = np.unique(colors[colors >= 0])
+                remap = np.zeros(max(int(used.max()) + 1, 1), dtype=np.int64)
+                remap[used] = np.arange(len(used))
+                dense = np.where(colors >= 0, remap[np.maximum(colors, 0)],
+                                 len(used))
+                n_classes = len(used) + 1
+                cpad = np.full(dg.total_padded_vertices, n_classes - 1,
+                               dtype=np.int32)
+                cpad[dg.old_to_pad] = dense
+                color_dev = (shard_1d(mesh, cpad) if mesh is not None
+                             else jnp.asarray(cpad))
+
         runner = PhaseRunner(dg, mesh=mesh, engine=engine)
         comm_pad, curr_mod, iters = runner.run(
-            th, lower=-1.0, et_mode=et_mode, et_delta=et_delta
+            th, lower=-1.0, et_mode=et_mode, et_delta=et_delta,
+            color_classes=color_dev, n_color_classes=n_classes,
         )
         t2 = time.perf_counter()
         tot_iters += iters

@@ -89,3 +89,86 @@ def test_bucketed_weighted_selfloops():
     for (t1, q1, _), (t2, q2, _) in zip(sort_trace, bucket_trace):
         np.testing.assert_array_equal(t1, t2)
         assert q2 == pytest.approx(q1, abs=1e-6)
+
+
+def test_heavy_path_and_chunking_with_small_widths():
+    """Exercise the heavy fallback and lax.map chunked rows explicitly by
+    shrinking the bucket widths (default widths leave rmat(9) heavy-free)."""
+    import jax.numpy as jnp
+    import cuvite_tpu.louvain.bucketed as bk
+    from cuvite_tpu.louvain.bucketed import BucketPlan, bucketed_step
+    from cuvite_tpu.louvain.step import make_single_step
+
+    g = generate_rmat(9, edge_factor=8, seed=2)
+    dg = DistGraph.build(g, 1)
+    sh = dg.shards[0]
+    plan = BucketPlan.build(np.asarray(sh.src), np.asarray(sh.dst),
+                            np.asarray(sh.w), nv_local=dg.nv_pad, base=0,
+                            widths=(4, 8))  # most vertices become heavy
+    assert plan.has_heavy
+    vdt, wdt = np.int32, np.float32
+    buckets = tuple(
+        (jnp.asarray(b.verts.astype(vdt)), jnp.asarray(b.dst.astype(vdt)),
+         jnp.asarray(b.w.astype(wdt))) for b in plan.buckets)
+    heavy = (jnp.asarray(plan.heavy_src.astype(vdt)),
+             jnp.asarray(plan.heavy_dst.astype(vdt)),
+             jnp.asarray(plan.heavy_w.astype(wdt)))
+    sl = jnp.asarray(plan.self_loop.astype(wdt))
+    nvt = dg.total_padded_vertices
+    comm = jnp.arange(nvt, dtype=vdt)
+    vdeg = jnp.asarray(dg.padded_weighted_degrees().astype(wdt))
+    const = jnp.asarray(1.0 / g.total_edge_weight_twice(), dtype=wdt)
+
+    ref_step = make_single_step(nvt)
+    src, dst, w = dg.stacked_edges()
+    for it in range(3):
+        t1, q1, m1 = ref_step(jnp.asarray(src), jnp.asarray(dst),
+                              jnp.asarray(w), comm, vdeg, const)
+        t2, q2, m2 = bucketed_step(buckets, heavy, sl, comm, vdeg, const,
+                                   nv_total=nvt, sentinel=np.iinfo(vdt).max)
+        np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2),
+                                      err_msg=f"iter {it}")
+        assert float(q2) == pytest.approx(float(q1), abs=1e-5)
+        comm = t1
+
+    # chunked path: force a tiny chunk so lax.map runs with many chunks
+    old = bk.ROW_ELEMS_CHUNK
+    try:
+        bk.ROW_ELEMS_CHUNK = 1 << 10
+        plan2 = BucketPlan.build(np.asarray(sh.src), np.asarray(sh.dst),
+                                 np.asarray(sh.w), nv_local=dg.nv_pad,
+                                 base=0, widths=(4, 64, 256))
+        buckets2 = tuple(
+            (jnp.asarray(b.verts.astype(vdt)),
+             jnp.asarray(b.dst.astype(vdt)),
+             jnp.asarray(b.w.astype(wdt))) for b in plan2.buckets)
+        heavy2 = (jnp.asarray(plan2.heavy_src.astype(vdt)),
+                  jnp.asarray(plan2.heavy_dst.astype(vdt)),
+                  jnp.asarray(plan2.heavy_w.astype(wdt)))
+        comm = jnp.arange(nvt, dtype=vdt)
+        t3, q3, _ = bucketed_step(buckets2, heavy2, sl, comm, vdeg, const,
+                                  nv_total=nvt, sentinel=np.iinfo(vdt).max)
+        t0, q0, _ = ref_step(jnp.asarray(src), jnp.asarray(dst),
+                             jnp.asarray(w), comm, vdeg, const)
+        np.testing.assert_array_equal(np.asarray(t0), np.asarray(t3))
+    finally:
+        bk.ROW_ELEMS_CHUNK = old
+
+
+def test_zero_weight_edges_engines_agree():
+    """Zero-weight real edges must be candidates in both engines."""
+    rng = np.random.default_rng(7)
+    g0 = generate_rgg(128, seed=1)
+    w = np.asarray(g0.weights).copy()
+    # zero out ~20% of undirected edges symmetrically: rebuild from edges
+    src, dst = g0.sources(), g0.tails
+    keep_mask = src < dst
+    es, ed = src[keep_mask], dst[keep_mask]
+    ew = w[keep_mask]
+    ew[rng.random(len(ew)) < 0.2] = 0.0
+    g = Graph.from_edges(128, es, ed, weights=ew)
+    sort_trace, bucket_trace = _run_engines_one_phase(g, iters=4)
+    for it, ((t1, q1, m1), (t2, q2, m2)) in enumerate(
+            zip(sort_trace, bucket_trace)):
+        np.testing.assert_array_equal(t1, t2, err_msg=f"iter {it}")
+        assert m1 == m2
