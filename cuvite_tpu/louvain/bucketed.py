@@ -29,11 +29,13 @@ Orchestration (what is static per phase vs dynamic per iteration):
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import PartitionSpec as P
 
 from cuvite_tpu.ops import segment as seg
 
@@ -152,6 +154,67 @@ class BucketPlan:
             self_loop=self_loop.astype(w.dtype),
             has_heavy=has_heavy,
         )
+
+
+@dataclasses.dataclass
+class StackedPlan:
+    """Per-shard BucketPlans padded to COMMON shapes and stacked shard-major,
+    ready to be sharded along axis 0 of a 1-D mesh (every shard must present
+    identical bucket geometry to the SPMD step — the analog of the
+    reference's per-rank symmetric kernel launches)."""
+
+    buckets: list            # list of (verts [S*Nb], dst [S*Nb, D], w [S*Nb, D])
+    heavy: tuple             # (src [S*H], dst [S*H], w [S*H])
+    self_loop: np.ndarray    # [S*nv_pad]
+
+
+def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS) -> StackedPlan:
+    """Build one BucketPlan per shard of ``dg`` and pad them to common
+    shapes.  A width class appears iff some shard has vertices in it; shards
+    without rows in a kept class contribute all-padding rows."""
+    nshards = dg.nshards
+    nvl = dg.nv_pad
+    plans = [
+        BucketPlan.build(
+            np.asarray(sh.src), np.asarray(sh.dst), np.asarray(sh.w),
+            nv_local=nvl, base=s * nvl, widths=widths,
+        )
+        for s, sh in enumerate(dg.shards)
+    ]
+    by_width = [{b.width: b for b in p.buckets} for p in plans]
+    stacked_buckets = []
+    for width in widths:
+        nbs = [len(bw[width].verts) if width in bw else 0 for bw in by_width]
+        nb = max(nbs)
+        if nb == 0:
+            continue
+        verts = np.full((nshards, nb), nvl, dtype=np.int64)
+        dmat = np.zeros((nshards, nb, width), dtype=plans[0].heavy_dst.dtype)
+        wmat = np.zeros((nshards, nb, width), dtype=plans[0].heavy_w.dtype)
+        for s, bw in enumerate(by_width):
+            if width in bw:
+                b = bw[width]
+                verts[s, : len(b.verts)] = b.verts
+                dmat[s, : len(b.verts)] = b.dst
+                wmat[s, : len(b.verts)] = b.w
+        stacked_buckets.append(
+            (verts.reshape(-1), dmat.reshape(-1, width),
+             wmat.reshape(-1, width))
+        )
+    hn = max(len(p.heavy_src) for p in plans)
+    hsrc = np.full((nshards, hn), nvl, dtype=plans[0].heavy_src.dtype)
+    hdst = np.zeros((nshards, hn), dtype=plans[0].heavy_dst.dtype)
+    hw = np.zeros((nshards, hn), dtype=plans[0].heavy_w.dtype)
+    for s, p in enumerate(plans):
+        hsrc[s, : len(p.heavy_src)] = p.heavy_src
+        hdst[s, : len(p.heavy_dst)] = p.heavy_dst
+        hw[s, : len(p.heavy_w)] = p.heavy_w
+    self_loop = np.concatenate([p.self_loop for p in plans])
+    return StackedPlan(
+        buckets=stacked_buckets,
+        heavy=(hsrc.reshape(-1), hdst.reshape(-1), hw.reshape(-1)),
+        self_loop=self_loop,
+    )
 
 
 class RowResult(NamedTuple):
@@ -280,23 +343,32 @@ def _rows_chunked(cmat, w_mat, curr, vdeg_v, eix_v, comm_deg, constant,
 
 
 def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
-                  constant, *, nv_total, sentinel, accum_dtype=None):
-    """Full single-shard Louvain sweep using the bucketed engine.
+                  constant, *, nv_total, sentinel, accum_dtype=None,
+                  axis_name=None):
+    """Full Louvain sweep over one shard using the bucketed engine.
 
     ``bucket_arrays`` is a tuple of (verts, dst_mat, w_mat) triples (one per
     degree class); ``heavy_arrays`` is (src, dst, w) for the residual
     heavy-vertex edges (may be empty-padded).  Returns (target, modularity,
     n_moved) with semantics identical to louvain_step_local — the two
     engines are interchangeable and tested for equal outputs.
+
+    With ``axis_name`` the function runs SPMD inside shard_map: ``comm`` /
+    ``vdeg`` / ``self_loop`` are this shard's slices, ``dst`` ids are global
+    (padded space), and the cross-shard community pull — the analog of
+    fillRemoteCommunities (/root/reference/louvain.cpp:2588-2959) — is an
+    all_gather of the community vector; scalar reductions ride psum.
     """
     nv_local = comm.shape[0]
     wdt = vdeg.dtype
     vdt = comm.dtype
 
-    comm_deg = seg.segment_sum(vdeg, comm, num_segments=nv_total)
-    comm_size = seg.segment_sum(
+    comm_full, gsum = seg.spmd_env(comm, axis_name)
+
+    comm_deg = gsum(seg.segment_sum(vdeg, comm, num_segments=nv_total))
+    comm_size = gsum(seg.segment_sum(
         jnp.ones((nv_local,), dtype=vdt), comm, num_segments=nv_total
-    )
+    ))
 
     # Per-vertex weight into the current community (incl. self-loops) comes
     # out of the bucket pass; start from zero and accumulate per class.
@@ -310,7 +382,7 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
     # then run the argmax passes.  For bucket rows counter0 is row-local;
     # compute it inline per bucket and assemble.
     hs, hd, hw = heavy_arrays
-    ckey_h = jnp.take(comm, hd)
+    ckey_h = jnp.take(comm_full, hd)
     csrc_h = jnp.take(comm, jnp.minimum(hs, nv_local - 1))
     c0_heavy = seg.segment_sum(
         jnp.where(ckey_h == csrc_h, hw, jnp.zeros_like(hw)), hs,
@@ -321,7 +393,7 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
 
     row_results = []
     for verts, dst_mat, w_mat in bucket_arrays:
-        cmat = jnp.take(comm, dst_mat)
+        cmat = jnp.take(comm_full, dst_mat)
         curr = jnp.take(comm, jnp.minimum(verts, nv_local - 1))
         c0_rows = jnp.sum(
             jnp.where(cmat == curr[:, None], w_mat, 0.0), axis=1
@@ -367,10 +439,35 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
     move = move & ~guard
     target = jnp.where(move, best_c_safe, comm)
 
-    acc = wdt if accum_dtype is None else accum_dtype
-    le_xx = jnp.sum(counter0.astype(acc))
-    la2_x = jnp.sum(jnp.square(comm_deg.astype(acc)))
-    c_acc = constant.astype(acc)
-    modularity = le_xx * c_acc - la2_x * c_acc * c_acc
-    n_moved = jnp.sum(move.astype(jnp.int32))
+    modularity = seg.modularity_terms(counter0, comm_deg, constant, gsum,
+                                      accum_dtype)
+    n_moved = gsum(jnp.sum(move.astype(jnp.int32)))
     return target, modularity, n_moved
+
+
+def make_sharded_bucketed_step(mesh, axis_name: str, n_buckets: int,
+                               nv_total: int, sentinel: int,
+                               accum_dtype=None):
+    """Jit the bucketed sweep as a shard_map over ``axis_name``: bucket
+    matrices, heavy slab and vertex state sharded along axis 0, modularity
+    and move count replicated."""
+    bspec = tuple((P(axis_name), P(axis_name), P(axis_name))
+                  for _ in range(n_buckets))
+    hspec = (P(axis_name), P(axis_name), P(axis_name))
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(bspec, hspec, P(axis_name), P(axis_name), P(axis_name),
+                  P()),
+        out_specs=(P(axis_name), P(), P()),
+        check_vma=False,
+    )
+    def step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant):
+        return bucketed_step(
+            bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant,
+            nv_total=nv_total, sentinel=sentinel, accum_dtype=accum_dtype,
+            axis_name=axis_name,
+        )
+
+    return jax.jit(step)

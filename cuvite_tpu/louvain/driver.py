@@ -36,7 +36,12 @@ from cuvite_tpu.core.types import (
     P_CUTOFF,
     TERMINATION_PHASE_COUNT,
 )
-from cuvite_tpu.louvain.bucketed import BucketPlan, bucketed_step
+from cuvite_tpu.louvain.bucketed import (
+    BucketPlan,
+    bucketed_step,
+    build_stacked_plans,
+    make_sharded_bucketed_step,
+)
 from cuvite_tpu.louvain.step import make_sharded_step, make_single_step
 
 
@@ -124,9 +129,9 @@ def _bucketed_jit(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
 class PhaseRunner:
     """Runs the iteration loop of one phase on a device mesh.
 
-    ``engine``: 'sort' — the edge-slab sort/segment step (works single and
-    multi-shard); 'bucketed' — the degree-bucketed engine (single-shard for
-    now), the analog of the reference GPU's degree-class kernels.
+    ``engine``: 'sort' — the edge-slab sort/segment step; 'bucketed' — the
+    degree-bucketed engine, the analog of the reference GPU's degree-class
+    kernels.  Both run single-shard or SPMD over a mesh.
     """
 
     def __init__(self, dg: DistGraph, mesh=None, engine: str = "sort"):
@@ -146,10 +151,39 @@ class PhaseRunner:
         adt = _device_dtype(dg.graph.policy.accum_dtype)
         multi = mesh is not None and int(np.prod(mesh.devices.shape)) > 1
         if engine == "bucketed" and multi:
-            raise NotImplementedError(
-                "bucketed engine is single-shard for now; use engine='sort'"
+            # SPMD bucketed path: per-shard plans padded to common shapes,
+            # sharded along the mesh; comm pull = all_gather inside the step.
+            sentinel = int(np.iinfo(vdt).max)
+            plan = build_stacked_plans(dg)
+            buckets = tuple(
+                (shard_1d(mesh, v.astype(vdt)),
+                 shard_1d(mesh, d.astype(vdt)),
+                 shard_1d(mesh, ww.astype(wdt)))
+                for v, d, ww in plan.buckets
             )
-        if engine == "bucketed":
+            heavy = tuple(
+                shard_1d(mesh, a.astype(t))
+                for a, t in zip(plan.heavy, (vdt, vdt, wdt))
+            )
+            self_loop = shard_1d(mesh, plan.self_loop.astype(wdt))
+            adt_np = np.dtype(adt)
+            key = ("bucketed", tuple(d.id for d in mesh.devices.flat),
+                   len(buckets), nv_total, sentinel, adt_np.name)
+            step_fn = _STEP_CACHE.get(key)
+            if step_fn is None:
+                step_fn = make_sharded_bucketed_step(
+                    mesh, VERTEX_AXIS, len(buckets), nv_total, sentinel,
+                    accum_dtype=adt_np,
+                )
+                _STEP_CACHE[key] = step_fn
+
+            def _step(src_, dst_, w_, comm, vdeg_, constant):
+                return step_fn(buckets, heavy, self_loop, comm, vdeg_,
+                               constant)
+
+            self._step = _step
+            self.src = self.dst = self.w = None
+        elif engine == "bucketed":
             # The bucket matrices replace the edge slab entirely: don't
             # upload src/dst/w (they would double edge memory on device).
             sh = dg.shards[0]
@@ -183,10 +217,11 @@ class PhaseRunner:
         self.real_mask = dg.vertex_mask()
         if multi:
             assert dg.nshards == int(np.prod(mesh.devices.shape))
-            src, dst, w = dg.stacked_edges()
-            self.src = shard_1d(mesh, src.astype(vdt))
-            self.dst = shard_1d(mesh, dst.astype(vdt))
-            self.w = shard_1d(mesh, w.astype(wdt))
+            if engine != "bucketed":
+                src, dst, w = dg.stacked_edges()
+                self.src = shard_1d(mesh, src.astype(vdt))
+                self.dst = shard_1d(mesh, dst.astype(vdt))
+                self.w = shard_1d(mesh, w.astype(wdt))
             self.vdeg = shard_1d(mesh, vdeg)
             self.comm0 = shard_1d(mesh, comm0)
             self.real_mask_dev = shard_1d(mesh, self.real_mask)
@@ -320,8 +355,8 @@ def louvain_phases(
 ) -> LouvainResult:
     """Full multi-phase Louvain (the main.cpp:218-495 loop).
 
-    ``engine='auto'`` picks the degree-bucketed step on a single shard and
-    the sort-based step on a mesh.
+    ``engine='auto'`` picks the degree-bucketed step (single-shard and
+    sharded); ``engine='sort'`` forces the edge-slab sort/segment step.
 
     ``coloring=N`` (reference -c N): distance-1 color the phase-0 graph with
     N/2 hash functions and run the per-color sub-sweep schedule
@@ -333,7 +368,7 @@ def louvain_phases(
     if mesh is None and nshards > 1:
         mesh = make_mesh(nshards)
     if engine == "auto":
-        engine = "bucketed" if nshards == 1 else "sort"
+        engine = "bucketed"
 
     nv0 = graph.num_vertices
     comm_all = np.arange(nv0, dtype=np.int64)

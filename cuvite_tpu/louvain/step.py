@@ -66,18 +66,11 @@ def louvain_step_local(
     vdt = comm_local.dtype
     sentinel = jnp.iinfo(vdt).max
 
+    comm_full, gsum = seg.spmd_env(comm_local, axis_name)
     if axis_name is None:
-        comm_full = comm_local
         base = 0
-
-        def gsum(x):
-            return x
     else:
-        comm_full = jax.lax.all_gather(comm_local, axis_name, tiled=True)
         base = jax.lax.axis_index(axis_name).astype(vdt) * nv_local
-
-        def gsum(x):
-            return jax.lax.psum(x, axis_name)
 
     # --- community info: size + weighted degree, recomputed fresh ---------
     comm_deg = gsum(
@@ -136,12 +129,8 @@ def louvain_step_local(
     target = jnp.where(move, best_c_safe, comm_local)
 
     # --- modularity of the INPUT assignment (louvain.cpp:2433-2481) -------
-    acc = wdt if accum_dtype is None else accum_dtype
-    le_xx = gsum(jnp.sum(counter0.astype(acc)))
-    # comm_deg is globally replicated after gsum: no second psum.
-    la2_x = jnp.sum(jnp.square(comm_deg.astype(acc)))
-    c_acc = constant.astype(acc)
-    modularity = le_xx * c_acc - la2_x * c_acc * c_acc
+    modularity = seg.modularity_terms(counter0, comm_deg, constant, gsum,
+                                      accum_dtype)
 
     n_moved = gsum(jnp.sum(move.astype(jnp.int32)))
     return StepOut(target=target, modularity=modularity, n_moved=n_moved)

@@ -36,6 +36,29 @@ def segment_min(data, segment_ids, num_segments, sorted_ids=False):
     )
 
 
+def spmd_env(comm_local, axis_name):
+    """Shared SPMD plumbing for the Louvain engines: returns
+    ``(comm_full, gsum)`` — the (all_gather'ed) full community vector and the
+    cross-shard scalar/array reduction.  Single-shard (``axis_name=None``)
+    degenerates to identity."""
+    if axis_name is None:
+        return comm_local, lambda x: x
+    comm_full = jax.lax.all_gather(comm_local, axis_name, tiled=True)
+    return comm_full, lambda x: jax.lax.psum(x, axis_name)
+
+
+def modularity_terms(counter0, comm_deg, constant, gsum, accum_dtype):
+    """Q = e·c − a²·c² from the per-vertex current-community weights and the
+    (already globally reduced) community degrees
+    (cf. distComputeModularity, /root/reference/louvain.cpp:2433-2481)."""
+    acc = counter0.dtype if accum_dtype is None else accum_dtype
+    le_xx = gsum(jnp.sum(counter0.astype(acc)))
+    # comm_deg is globally replicated after gsum: no second psum.
+    la2_x = jnp.sum(jnp.square(comm_deg.astype(acc)))
+    c_acc = constant.astype(acc)
+    return le_xx * c_acc - la2_x * c_acc * c_acc
+
+
 def sort_edges_by_vertex_comm(src, ckey, w):
     """Lexicographic sort of the edge slab by (src, ckey).
 

@@ -155,6 +155,62 @@ def test_heavy_path_and_chunking_with_small_widths():
         bk.ROW_ELEMS_CHUNK = old
 
 
+@pytest.mark.parametrize("nshards", [2, 8])
+def test_multishard_bucketed_matches_single(nshards):
+    """The sharded bucketed step (shard_map + all_gather/psum) must produce
+    the same trajectory as the single-shard engines."""
+    g = generate_rmat(9, edge_factor=8, seed=2)
+    single = _run_engines_one_phase(g)[1]
+
+    from cuvite_tpu.comm.mesh import make_mesh
+
+    dg1 = DistGraph.build(g, 1)
+    dg = DistGraph.build(g, nshards)
+    mesh = make_mesh(nshards)
+    r = PhaseRunner(dg, mesh=mesh, engine="bucketed")
+    comm = r.comm0
+    for it, (t1, q1, m1) in enumerate(single):
+        target, q, moved = r._step(None, None, None, comm, r.vdeg, r.constant)
+        # Labels are padded-space vertex ids and the padded layouts differ
+        # per nshards: map each to original-id space, compare as partitions.
+        lab1 = dg1.pad_to_old[t1[dg1.old_to_pad]]
+        labN = dg.pad_to_old[np.asarray(target)[dg.old_to_pad]]
+        assert _partition_signature(lab1) == _partition_signature(labN), \
+            f"diverged at iteration {it}"
+        assert float(q) == pytest.approx(q1, abs=1e-5)
+        assert int(moved) == m1
+        comm = target
+
+
+def _partition_signature(labels):
+    """Canonical form of a partition: tuple of frozensets of members."""
+    import collections
+
+    groups = collections.defaultdict(list)
+    for v, c in enumerate(np.asarray(labels)):
+        groups[int(c)].append(v)
+    return frozenset(frozenset(m) for m in groups.values())
+
+
+@pytest.mark.parametrize("nshards", [4])
+def test_full_run_multishard_bucketed(karate, nshards):
+    r1 = louvain_phases(karate, engine="bucketed")
+    rN = louvain_phases(karate, nshards=nshards, engine="bucketed")
+    assert rN.modularity == pytest.approx(r1.modularity, abs=1e-4)
+    np.testing.assert_array_equal(
+        _np_canon(r1.communities), _np_canon(rN.communities))
+
+
+def _np_canon(labels):
+    """Renumber labels by first appearance so partitions compare equal."""
+    labels = np.asarray(labels)
+    _, first = np.unique(labels, return_index=True)
+    order = np.argsort(first)
+    remap = np.empty(len(order), dtype=np.int64)
+    remap[order] = np.arange(len(order))
+    return remap[np.searchsorted(np.unique(labels), labels)]
+
+
 def test_zero_weight_edges_engines_agree():
     """Zero-weight real edges must be candidates in both engines."""
     rng = np.random.default_rng(7)
