@@ -22,6 +22,19 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_EDGES_PER_SEC_PER_CHIP = 1.0e9 / 64.0
 
+# Persistent XLA compilation cache: compiles dominate first-run wall time
+# (~30s per distinct phase shape on v5e); repeated bench runs skip them
+# entirely.  Opt out with CUVITE_NO_COMPILE_CACHE=1.
+if not os.environ.get("CUVITE_NO_COMPILE_CACHE"):
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
 
 def main():
     scale = int(os.environ.get("BENCH_SCALE", "20"))
@@ -40,8 +53,12 @@ def main():
     print(f"# graph: {kind} scale={scale} nv={graph.num_vertices} "
           f"ne={graph.num_edges} gen={gen_s:.1f}s", file=sys.stderr)
 
-    # Warm-up phase-0 compile so TEPS measures steady-state execution.
-    res = louvain_phases(graph, one_phase=True, threshold=1e-2)
+    # Warm-up: a full multi-phase run on the same graph.  The run is
+    # deterministic, so every coarsened phase of the timed run hits the
+    # in-memory jit cache and TEPS measures steady-state execution, not
+    # XLA compilation (the reference likewise excludes one-time costs from
+    # its clustering-time metric, main.cpp:499-518).
+    res = louvain_phases(graph)
     del res
 
     t1 = time.perf_counter()

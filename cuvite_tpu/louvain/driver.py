@@ -120,10 +120,80 @@ def _get_step(mesh, nv_total: int, accum_dtype) -> object:
 )
 def _bucketed_jit(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
                   constant, *, nv_total, sentinel, accum_dtype):
-    return bucketed_step(
-        bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant,
-        nv_total=nv_total, sentinel=sentinel, accum_dtype=accum_dtype,
-    )
+    call = _bucketed_call(nv_total, sentinel, accum_dtype)
+    return call(comm, (bucket_arrays, heavy_arrays, self_loop, vdeg,
+                       constant))
+
+
+# ---------------------------------------------------------------------------
+# On-device phase loop.
+#
+# The reference re-checks `(currMod - prevMod) < threshold` on the host every
+# iteration (louvain.cpp:541-546) — on TPU that is one blocking device->host
+# scalar fetch per iteration, which over a remote device link costs orders of
+# magnitude more than the step itself.  The TPU-native driver runs the whole
+# iteration loop inside one lax.while_loop, with the convergence check on
+# device, and syncs once per phase.  Semantics are identical to
+# PhaseRunner.run's Python loop (the returned assignment is `past`, the last
+# one whose gain passed the threshold).
+
+@functools.partial(jax.jit, static_argnames=("call", "max_iters"))
+def _run_phase_loop(extra, comm0, threshold, lower, *, call, max_iters):
+    wdt = lower.dtype
+
+    def cond(c):
+        return ~c[4]
+
+    def body(c):
+        past, comm, prev_mod, iters, _ = c
+        target, mod, _ = call(comm, extra)
+        mod = mod.astype(wdt)
+        iters1 = iters + 1
+        no_gain = (mod - prev_mod) < threshold
+        stop = no_gain | (iters1 >= max_iters)
+        new_prev = jnp.where(no_gain, prev_mod, jnp.maximum(mod, lower))
+        new_past = jnp.where(no_gain, past, comm)
+        new_comm = jnp.where(no_gain, comm, target)
+        return (new_past, new_comm, new_prev, iters1, stop)
+
+    init = (comm0, comm0, lower, jnp.int32(0), jnp.bool_(False))
+    past, _, prev_mod, iters, _ = jax.lax.while_loop(cond, body, init)
+    return past, prev_mod, iters
+
+
+@functools.lru_cache(maxsize=None)
+def _bucketed_call(nv_total, sentinel, accum_dtype):
+    def call(comm, extra):
+        buckets, heavy, self_loop, vdeg, constant = extra
+        return bucketed_step(
+            buckets, heavy, self_loop, comm, vdeg, constant,
+            nv_total=nv_total, sentinel=sentinel, accum_dtype=accum_dtype,
+        )
+
+    return call
+
+
+@functools.lru_cache(maxsize=None)
+def _bucketed_sharded_call(step_fn):
+    def call(comm, extra):
+        buckets, heavy, self_loop, vdeg, constant = extra
+        return step_fn(buckets, heavy, self_loop, comm, vdeg, constant)
+
+    return call
+
+
+@functools.lru_cache(maxsize=None)
+def _step_call(step):
+    """Adapt a cached (src,dst,w,comm,vdeg,constant) step — jitted closure
+    or shard_map wrapper — to the (comm, extra) loop convention.  lru_cache
+    keeps the wrapper's identity stable so _run_phase_loop's static `call`
+    does not retrace on reuse."""
+
+    def call(comm, extra):
+        src, dst, w, vdeg, constant = extra
+        return step(src, dst, w, comm, vdeg, constant)
+
+    return call
 
 
 class PhaseRunner:
@@ -182,6 +252,8 @@ class PhaseRunner:
                                constant)
 
             self._step = _step
+            self._call = _bucketed_sharded_call(step_fn)
+            self._bucket_extra = (buckets, heavy, self_loop)
             self.src = self.dst = self.w = None
         elif engine == "bucketed":
             # The bucket matrices replace the edge slab entirely: don't
@@ -211,9 +283,13 @@ class PhaseRunner:
                 )
 
             self._step = _step
+            self._call = _bucketed_call(nv_total, sentinel, adt_np)
+            self._bucket_extra = (buckets, heavy, self_loop)
             self.src = self.dst = self.w = None
         else:
             self._step = _get_step(mesh, nv_total, adt)
+            self._call = _step_call(self._step)
+            self._bucket_extra = None
         self.real_mask = dg.vertex_mask()
         if multi:
             assert dg.nshards == int(np.prod(mesh.devices.shape))
@@ -237,6 +313,12 @@ class PhaseRunner:
             self.real_mask_dev = jnp.asarray(self.real_mask)
         tw = dg.graph.total_edge_weight_twice()
         self.constant = jnp.asarray(1.0 / tw, dtype=wdt)
+        if self._bucket_extra is not None:
+            b, h, sl = self._bucket_extra
+            self._extra = (b, h, sl, self.vdeg, self.constant)
+        else:
+            self._extra = (self.src, self.dst, self.w, self.vdeg,
+                           self.constant)
 
     def run(
         self,
@@ -277,6 +359,19 @@ class PhaseRunner:
         n_color_classes full sweeps (typically fewer iterations in
         exchange); per-class bucket subsets are the planned optimization.
         """
+        if et_mode == 0 and color_classes is None:
+            # Default path: the whole iteration loop runs on device with the
+            # convergence check inside (one host sync per phase instead of
+            # one per iteration).
+            wdt = self.constant.dtype
+            past_d, prev_mod_d, iters_d = _run_phase_loop(
+                self._extra, self.comm0,
+                jnp.asarray(threshold, dtype=wdt),
+                jnp.asarray(lower, dtype=wdt),
+                call=self._call, max_iters=MAX_TOTAL_ITERATIONS,
+            )
+            return (np.asarray(jax.device_get(past_d)), float(prev_mod_d),
+                    int(iters_d))
         comm = self.comm0
         past = comm
         prev_mod = lower
