@@ -86,10 +86,25 @@ class Graph:
         policy = policy or default_policy()
         src = np.asarray(src, dtype=np.int64)
         dst = np.asarray(dst, dtype=np.int64)
+        # Accumulate duplicate-edge sums from the raw f64 weights; the cast
+        # to the policy dtype happens once, on the coalesced result (same
+        # contract as the native builder, native/cuvite_native.cpp).
         if weights is None:
-            w = np.ones(len(src), dtype=policy.weight_dtype)
+            w = np.ones(len(src), dtype=np.float64)
         else:
-            w = np.asarray(weights, dtype=policy.weight_dtype)
+            w = np.asarray(weights, dtype=np.float64)
+        from cuvite_tpu import native
+
+        if len(src) >= (1 << 16) and native.available():
+            offsets, tails, wsum = native.build_csr(
+                num_vertices, src, dst, w, symmetrize
+            )
+            return Graph(
+                offsets=offsets,
+                tails=tails.astype(policy.vertex_dtype),
+                weights=wsum.astype(policy.weight_dtype),
+                policy=policy,
+            )
         if symmetrize:
             keep = src != dst
             src2 = np.concatenate([src, dst[keep]])

@@ -14,6 +14,8 @@
 - `generate_rmat`: Graph500-style R-MAT generator (a=0.57, b=0.19, c=0.19)
   for the benchmark configs in BASELINE.md (not present in the reference,
   which defers non-RGG formats to external converters, README:36-40).
+  Uses a counter-based SplitMix64 RNG so the numpy fallback and the native
+  C++ fast path (native/cuvite_native.cpp) generate bit-identical graphs.
 """
 
 from __future__ import annotations
@@ -21,9 +23,10 @@ from __future__ import annotations
 import numpy as np
 from scipy.spatial import cKDTree
 
+from cuvite_tpu import native
 from cuvite_tpu.core.graph import Graph
 from cuvite_tpu.core.types import Policy, default_policy
-from cuvite_tpu.utils.rng import lcg_stream
+from cuvite_tpu.utils.rng import lcg_stream, scramble_ids, splitmix64, u01
 
 
 def rgg_radius(nv: int) -> float:
@@ -88,6 +91,31 @@ def generate_rgg(
     return Graph.from_edges(nv_eff, src, dst, weights=w, policy=policy)
 
 
+def rmat_edges_numpy(scale: int, ne: int, seed: int, a: float, b: float,
+                     c: float) -> tuple[np.ndarray, np.ndarray]:
+    """Pure-numpy R-MAT edge list; bit-identical to cv_rmat
+    (native/cuvite_native.cpp).  Per edge e and recursion level l, the
+    quadrant draws are splitmix64(seed + e*2*scale + 2l [+1])."""
+    ab = a + b
+    a_norm = a / ab
+    c_norm = c / (1.0 - ab)
+    base = (np.arange(ne, dtype=np.uint64) * np.uint64(2 * scale)
+            + np.uint64(seed))
+    src = np.zeros(ne, dtype=np.uint64)
+    dst = np.zeros(ne, dtype=np.uint64)
+    one = np.uint64(1)
+    for level in range(scale):
+        r1 = u01(splitmix64(base + np.uint64(2 * level)))
+        r2 = u01(splitmix64(base + np.uint64(2 * level + 1)))
+        sbit = r1 > ab
+        dbit = np.where(sbit, r2 > c_norm, r2 > a_norm)
+        src = (src << one) | sbit.astype(np.uint64)
+        dst = (dst << one) | dbit.astype(np.uint64)
+    src = scramble_ids(src, scale, seed).astype(np.int64)
+    dst = scramble_ids(dst, scale, seed).astype(np.int64)
+    return src, dst
+
+
 def generate_rmat(
     scale: int,
     edge_factor: int = 16,
@@ -102,23 +130,9 @@ def generate_rmat(
     policy = policy or default_policy()
     nv = 1 << scale
     ne = edge_factor << scale
-    rng = np.random.default_rng(seed)
-    src = np.zeros(ne, dtype=np.int64)
-    dst = np.zeros(ne, dtype=np.int64)
-    ab = a + b
-    a_norm = a / ab
-    c_norm = c / (1.0 - ab)
-    for _ in range(scale):
-        r1 = rng.random(ne)
-        r2 = rng.random(ne)
-        src_bit = r1 > ab
-        dst_bit = np.where(
-            src_bit, r2 > c_norm, r2 > a_norm
-        )
-        src = (src << 1) | src_bit
-        dst = (dst << 1) | dst_bit
-    # permute vertex ids to break the degree/id correlation
-    perm = rng.permutation(nv)
-    src, dst = perm[src], perm[dst]
+    if native.available():
+        src, dst = native.rmat_edges(scale, ne, seed, a, b, c)
+    else:
+        src, dst = rmat_edges_numpy(scale, ne, seed, a, b, c)
     keep = src != dst
     return Graph.from_edges(nv, src[keep], dst[keep], policy=policy)

@@ -74,6 +74,19 @@ def read_vite(
             f"{path}: non-monotone CSR offsets — wrong bits64={bits64} flag "
             f"or corrupt file"
         )
+    from cuvite_tpu import native
+
+    if (e1 - e0) >= (1 << 16) and native.available():
+        # Native bulk read: one sequential fread + parallel deinterleave
+        # (the numpy memmap path does two strided passes over the edge
+        # records).  Offsets were already read and validated above.
+        tails_n, weights_n = native.vite_edges(path, bits64, nv, e0, e1)
+        return Graph(
+            offsets=offsets - e0,
+            tails=tails_n.astype(policy.vertex_dtype),
+            weights=weights_n.astype(policy.weight_dtype),
+            policy=policy,
+        )
     edges_offset = 2 * elem.itemsize + (nv + 1) * elem.itemsize
     edges_map = np.memmap(
         path, dtype=edge, mode="r", offset=edges_offset + e0 * edge.itemsize,
@@ -96,6 +109,15 @@ def write_vite(path: str, graph: Graph, bits64: bool = True) -> None:
     edge = _edge_dtype(bits64)
     nv = graph.num_vertices
     ne = graph.num_edges
+    from cuvite_tpu import native
+
+    if ne >= (1 << 16) and native.available():
+        native.vite_write(
+            path, bits64, graph.offsets,
+            graph.tails.astype(np.int64),
+            graph.weights.astype(np.float64),
+        )
+        return
     with open(path, "wb") as f:
         np.array([nv, ne], dtype=elem).tofile(f)
         graph.offsets.astype(elem).tofile(f)

@@ -1,0 +1,199 @@
+"""ctypes bindings for the native host runtime (native/cuvite_native.cpp).
+
+The native library accelerates the host-side data layer — CSR construction,
+R-MAT generation, Vite binary I/O — the role the reference fills with its
+C++/MPI loader and generator (/root/reference/distgraph.cpp).  Every entry
+point has a bit-identical pure-numpy fallback in the rest of the package, so
+the library is an accelerator, never a requirement: ``available()`` gates
+every use.
+
+Build: ``make -C native`` at the repo root, or implicitly on first import
+(disable with CUVITE_NO_NATIVE=1).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_LIB = None  # None = not tried; False = unavailable; else CDLL
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _so_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "libcuvite_native.so")
+
+
+def _try_build() -> bool:
+    src_dir = os.path.join(_repo_root(), "native")
+    if not os.path.isfile(os.path.join(src_dir, "cuvite_native.cpp")):
+        return False
+    try:
+        r = subprocess.run(["make", "-C", src_dir], capture_output=True,
+                           timeout=180)
+        return r.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    i64 = ctypes.c_int64
+    u64 = ctypes.c_uint64
+    f64 = ctypes.c_double
+    p_i64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    p_f64 = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+    lib.cv_build_csr.restype = i64
+    lib.cv_build_csr.argtypes = [i64, i64, p_i64, p_i64, p_f64,
+                                 ctypes.c_int, p_i64, p_i64, p_f64]
+    lib.cv_rmat.restype = None
+    lib.cv_rmat.argtypes = [ctypes.c_int, i64, u64, f64, f64, f64,
+                            p_i64, p_i64]
+    lib.cv_vite_header.restype = ctypes.c_int
+    lib.cv_vite_header.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                   ctypes.POINTER(i64), ctypes.POINTER(i64)]
+    lib.cv_vite_offsets.restype = ctypes.c_int
+    lib.cv_vite_offsets.argtypes = [ctypes.c_char_p, ctypes.c_int, i64, i64,
+                                    p_i64]
+    lib.cv_vite_edges.restype = ctypes.c_int
+    lib.cv_vite_edges.argtypes = [ctypes.c_char_p, ctypes.c_int, i64, i64,
+                                  i64, p_i64, p_f64]
+    lib.cv_vite_write.restype = ctypes.c_int
+    lib.cv_vite_write.argtypes = [ctypes.c_char_p, ctypes.c_int, i64, i64,
+                                  p_i64, p_i64, p_f64]
+    lib.cv_balanced_parts.restype = None
+    lib.cv_balanced_parts.argtypes = [i64, p_i64, i64, p_i64]
+    lib.cv_openmp_threads.restype = ctypes.c_int
+    lib.cv_openmp_threads.argtypes = []
+
+
+def _load():
+    global _LIB
+    if _LIB is not None:
+        return _LIB or None
+    if os.environ.get("CUVITE_NO_NATIVE"):
+        _LIB = False
+        return None
+    so = _so_path()
+    src = os.path.join(_repo_root(), "native", "cuvite_native.cpp")
+    stale = (not os.path.isfile(so)
+             or (os.path.isfile(src)
+                 and os.path.getmtime(src) > os.path.getmtime(so)))
+    if stale and not _try_build():
+        # Never load a stale library: its output may no longer match the
+        # current numpy fallbacks, silently breaking reproducibility.
+        _LIB = False
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+        _bind(lib)
+        _LIB = lib
+    except OSError:
+        _LIB = False
+        return None
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_csr(num_vertices: int, src: np.ndarray, dst: np.ndarray,
+              weights: np.ndarray, symmetrize: bool = True):
+    """Edge list -> coalesced CSR, identical to the numpy path in
+    Graph.from_edges.  Returns (offsets, tails[f64 ids], weights[f64])."""
+    lib = _load()
+    assert lib is not None
+    src = np.ascontiguousarray(src, dtype=np.int64)
+    dst = np.ascontiguousarray(dst, dtype=np.int64)
+    w = np.ascontiguousarray(weights, dtype=np.float64)
+    cap = 2 * len(src) if symmetrize else len(src)
+    cap = max(cap, 1)
+    offsets = np.empty(num_vertices + 1, dtype=np.int64)
+    tails = np.empty(cap, dtype=np.int64)
+    wout = np.empty(cap, dtype=np.float64)
+    n = lib.cv_build_csr(num_vertices, len(src), src, dst, w,
+                         int(symmetrize), offsets, tails, wout)
+    if n < 0:
+        raise ValueError("edge endpoint out of range")
+    return offsets, tails[:n].copy(), wout[:n].copy()
+
+
+def rmat_edges(scale: int, ne: int, seed: int, a: float, b: float, c: float):
+    """Counter-based R-MAT edge list (SplitMix64; bit-identical to the numpy
+    fallback in cuvite_tpu.io.generate)."""
+    lib = _load()
+    assert lib is not None
+    src = np.empty(ne, dtype=np.int64)
+    dst = np.empty(ne, dtype=np.int64)
+    lib.cv_rmat(scale, ne, seed, a, b, c, src, dst)
+    return src, dst
+
+
+def vite_header(path: str, bits64: bool):
+    lib = _load()
+    assert lib is not None
+    nv = ctypes.c_int64()
+    ne = ctypes.c_int64()
+    rc = lib.cv_vite_header(path.encode(), int(bits64),
+                            ctypes.byref(nv), ctypes.byref(ne))
+    if rc != 0:
+        raise ValueError(f"{path}: cannot read Vite header (rc={rc})")
+    return int(nv.value), int(ne.value)
+
+
+def vite_edges(path: str, bits64: bool, nv: int, e0: int, e1: int):
+    """Edge records [e0, e1): one sequential read + parallel deinterleave
+    into (tails, weights).  Offsets come from the caller (already read and
+    validated by read_vite)."""
+    lib = _load()
+    assert lib is not None
+    tails = np.empty(max(e1 - e0, 1), dtype=np.int64)
+    weights = np.empty(max(e1 - e0, 1), dtype=np.float64)
+    rc = lib.cv_vite_edges(path.encode(), int(bits64), nv, e0, e1, tails,
+                           weights)
+    if rc != 0:
+        raise ValueError(f"{path}: edge read failed (rc={rc})")
+    return tails[: e1 - e0], weights[: e1 - e0]
+
+
+def vite_read(path: str, bits64: bool, lo: int, hi: int, nv: int):
+    """Rows [lo, hi): re-based offsets + deinterleaved tails/weights."""
+    lib = _load()
+    assert lib is not None
+    offsets = np.empty(hi - lo + 1, dtype=np.int64)
+    rc = lib.cv_vite_offsets(path.encode(), int(bits64), lo, hi, offsets)
+    if rc != 0:
+        raise ValueError(f"{path}: offset read failed (rc={rc})")
+    e0, e1 = int(offsets[0]), int(offsets[-1])
+    tails, weights = vite_edges(path, bits64, nv, e0, e1)
+    return offsets - e0, tails, weights
+
+
+def vite_write(path: str, bits64: bool, offsets: np.ndarray,
+               tails: np.ndarray, weights: np.ndarray) -> None:
+    lib = _load()
+    assert lib is not None
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    tails = np.ascontiguousarray(tails, dtype=np.int64)
+    weights = np.ascontiguousarray(weights, dtype=np.float64)
+    rc = lib.cv_vite_write(path.encode(), int(bits64), len(offsets) - 1,
+                           len(tails), offsets, tails, weights)
+    if rc != 0:
+        raise ValueError(f"{path}: write failed (rc={rc})")
+
+
+def balanced_parts(offsets: np.ndarray, nparts: int) -> np.ndarray:
+    lib = _load()
+    assert lib is not None
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    parts = np.empty(nparts + 1, dtype=np.int64)
+    lib.cv_balanced_parts(len(offsets) - 1, offsets, nparts, parts)
+    return parts

@@ -1,0 +1,343 @@
+// cuvite_tpu native host runtime: graph ingest, CSR construction and
+// synthetic-graph generation.
+//
+// This is the TPU framework's equivalent of the reference's native host
+// layer (the MPI-IO loader /root/reference/distgraph.cpp:69-337, the CSR
+// assembly in send_newEdges /root/reference/rebuild.cpp:379-427, and the
+// in-memory generator /root/reference/distgraph.cpp:341-933).  The device
+// compute path is JAX/XLA/Pallas; everything here runs on the host CPU,
+// feeding device-ready struct-of-arrays buffers.
+//
+// Design constraints:
+//  * bit-deterministic: every routine produces output identical to the
+//    pure-numpy fallback in cuvite_tpu (tested in tests/test_native.py),
+//    so a run is reproducible with or without the native library.
+//  * OpenMP where it pays (per-row sorts, deinterleaving); serial where
+//    determinism of float accumulation order matters.
+//  * C ABI only — bound from Python via ctypes, no pybind11.
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// CSR construction from an edge list.
+//
+// Matches cuvite_tpu.core.graph.Graph.from_edges exactly:
+//   - symmetrize: append (dst,src,w) for every non-self edge, after the
+//     originals (same virtual concatenation order as the numpy path);
+//   - sort by (src, dst) with duplicates kept in input order (stable);
+//   - coalesce duplicates by summing weights in double, in input order
+//     (numpy's np.add.at order after a stable argsort).
+//
+// offsets_out must hold nv+1 entries; tails_out/weights_out must hold
+// (symmetrize ? 2*ne : ne) entries.  Returns the number of unique CSR
+// entries written, or -1 on bad input (src/dst out of range).
+int64_t cv_build_csr(int64_t nv, int64_t ne, const int64_t* src,
+                     const int64_t* dst, const double* w, int symmetrize,
+                     int64_t* offsets_out, int64_t* tails_out,
+                     double* weights_out) {
+  for (int64_t j = 0; j < ne; ++j) {
+    if (src[j] < 0 || src[j] >= nv || dst[j] < 0 || dst[j] >= nv) return -1;
+  }
+  // Expanded (virtually concatenated) edge list.
+  int64_t m = ne;
+  std::vector<int64_t> xs, xd;
+  std::vector<double> xw;
+  if (symmetrize) {
+    int64_t nself = 0;
+    for (int64_t j = 0; j < ne; ++j) nself += (src[j] == dst[j]);
+    m = 2 * ne - nself;
+    xs.resize(m);
+    xd.resize(m);
+    xw.resize(m);
+    std::memcpy(xs.data(), src, ne * sizeof(int64_t));
+    std::memcpy(xd.data(), dst, ne * sizeof(int64_t));
+    std::memcpy(xw.data(), w, ne * sizeof(double));
+    int64_t k = ne;
+    for (int64_t j = 0; j < ne; ++j) {
+      if (src[j] != dst[j]) {
+        xs[k] = dst[j];
+        xd[k] = src[j];
+        xw[k] = w[j];
+        ++k;
+      }
+    }
+  } else {
+    xs.assign(src, src + ne);
+    xd.assign(dst, dst + ne);
+    xw.assign(w, w + ne);
+  }
+
+  // LSD radix sort of the composite key src*nv + dst with the weight as
+  // payload.  Stable, so duplicate edges stay in input order and the f64
+  // coalescing sums accumulate in exactly the order the numpy path's
+  // np.add.at does (bit-identical results).  Only the bytes the key can
+  // actually occupy are sorted (2*ceil(log2 nv) bits).
+  std::vector<uint64_t> key(m), key2(m);
+  std::vector<double> pw(xw), pw2(m);
+  const uint64_t unv = (uint64_t)nv;
+  for (int64_t j = 0; j < m; ++j)
+    key[j] = (uint64_t)xs[j] * unv + (uint64_t)xd[j];
+  xs.clear(); xs.shrink_to_fit();
+  xd.clear(); xd.shrink_to_fit();
+  xw.clear(); xw.shrink_to_fit();
+  int key_bits = 0;
+  {
+    uint64_t maxkey = unv * unv - 1;
+    while (maxkey) { ++key_bits; maxkey >>= 1; }
+  }
+  for (int shift = 0; shift < key_bits; shift += 8) {
+    int64_t hist[257] = {0};
+    for (int64_t j = 0; j < m; ++j) hist[((key[j] >> shift) & 0xFF) + 1]++;
+    for (int b = 0; b < 256; ++b) hist[b + 1] += hist[b];
+    for (int64_t j = 0; j < m; ++j) {
+      int64_t slot = hist[(key[j] >> shift) & 0xFF]++;
+      key2[slot] = key[j];
+      pw2[slot] = pw[j];
+    }
+    key.swap(key2);
+    pw.swap(pw2);
+  }
+
+  // Linear coalesce of the sorted (key, weight) stream into the CSR.
+  std::memset(offsets_out, 0, (nv + 1) * sizeof(int64_t));
+  int64_t n_out = 0;
+  uint64_t prev_key = ~0ull;
+  for (int64_t j = 0; j < m; ++j) {
+    if (key[j] == prev_key) {
+      weights_out[n_out - 1] += pw[j];
+    } else {
+      prev_key = key[j];
+      tails_out[n_out] = (int64_t)(key[j] % unv);
+      weights_out[n_out] = pw[j];
+      offsets_out[key[j] / unv + 1]++;
+      ++n_out;
+    }
+  }
+  for (int64_t v = 0; v < nv; ++v) offsets_out[v + 1] += offsets_out[v];
+  return n_out;
+}
+
+// ---------------------------------------------------------------------------
+// Counter-based RNG (SplitMix64): stateless, trivially parallel, and
+// reproduced verbatim by the numpy fallback (cuvite_tpu/utils/rng.py).
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9E3779B97F4A7C15ull;
+  x ^= x >> 30;
+  x *= 0xBF58476D1CE4E5B9ull;
+  x ^= x >> 27;
+  x *= 0x94D049BB133111EBull;
+  x ^= x >> 31;
+  return x;
+}
+
+static inline double u01(uint64_t x) {
+  return (double)(x >> 11) * (1.0 / 9007199254740992.0); /* 2^-53 */
+}
+
+// Deterministic bijective scramble of [0, 2^bits): rounds of
+// (multiply by odd constant mod 2^bits, xor with own high half).  Replaces
+// the numpy path's rng.permutation for breaking the R-MAT id/degree
+// correlation; identical formula in cuvite_tpu/utils/rng.py:scramble_ids.
+static inline uint64_t scramble(uint64_t x, int bits, uint64_t seed) {
+  const uint64_t mask = (bits >= 64) ? ~0ull : ((1ull << bits) - 1);
+  const uint64_t odd1 = (splitmix64(seed ^ 0xA5A5A5A5ull) | 1ull);
+  const uint64_t odd2 = (splitmix64(seed ^ 0x5A5A5A5Aull) | 1ull);
+  int h = bits / 2 > 0 ? bits / 2 : 1;
+  x = (x * odd1) & mask;
+  x ^= x >> h;
+  x = (x * odd2) & mask;
+  x ^= x >> h;
+  return x & mask;
+}
+
+// Graph500-style R-MAT edge generator: ne edges over 2^scale vertices with
+// recursive quadrant probabilities (a, b, c, 1-a-b-c).  Equivalent in role
+// to the reference's in-memory generator entry point
+// (/root/reference/distgraph.cpp:341-357); the RGG variant lives in Python
+// (KD-tree based) — this native path serves the large benchmark graphs.
+void cv_rmat(int scale, int64_t ne, uint64_t seed, double a, double b,
+             double c, int64_t* src_out, int64_t* dst_out) {
+  const double ab = a + b;
+  const double a_norm = a / ab;
+  const double c_norm = c / (1.0 - ab);
+#pragma omp parallel for schedule(static)
+  for (int64_t e = 0; e < ne; ++e) {
+    uint64_t s = 0, d = 0;
+    const uint64_t base = seed + (uint64_t)e * (uint64_t)(2 * scale);
+    for (int l = 0; l < scale; ++l) {
+      double r1 = u01(splitmix64(base + (uint64_t)(2 * l)));
+      double r2 = u01(splitmix64(base + (uint64_t)(2 * l + 1)));
+      uint64_t sbit = r1 > ab;
+      uint64_t dbit = sbit ? (r2 > c_norm) : (r2 > a_norm);
+      s = (s << 1) | sbit;
+      d = (d << 1) | dbit;
+    }
+    src_out[e] = (int64_t)scramble(s, scale, seed);
+    dst_out[e] = (int64_t)scramble(d, scale, seed);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Vite binary graph format (layout: cuvite_tpu/io/vite.py and the
+// reference loader /root/reference/distgraph.cpp:99-197):
+//   [nv][ne] [offsets (nv+1)] [edges ne x {tail, weight}]
+// with 64-bit (i8/f8) or 32-bit (i4/f4) element widths.
+
+int cv_vite_header(const char* path, int bits64, int64_t* nv_out,
+                   int64_t* ne_out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int rc = 0;
+  if (bits64) {
+    int64_t h[2];
+    rc = std::fread(h, sizeof(int64_t), 2, f) == 2 ? 0 : -2;
+    if (rc == 0) { *nv_out = h[0]; *ne_out = h[1]; }
+  } else {
+    int32_t h[2];
+    rc = std::fread(h, sizeof(int32_t), 2, f) == 2 ? 0 : -2;
+    if (rc == 0) { *nv_out = h[0]; *ne_out = h[1]; }
+  }
+  std::fclose(f);
+  return rc;
+}
+
+// Reads rows [lo, hi) of the CSR: offsets re-based to 0 (nv_local+1 entries)
+// and the corresponding tail/weight slices, deinterleaved to
+// struct-of-arrays.  Buffers must be sized from a prior cv_vite_header +
+// offsets probe (cv_vite_offsets).  Returns 0 on success.
+int cv_vite_offsets(const char* path, int bits64, int64_t lo, int64_t hi,
+                    int64_t* offsets_out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  const int64_t esz = bits64 ? 8 : 4;
+  if (std::fseek(f, (long)(2 * esz + lo * esz), SEEK_SET) != 0) {
+    std::fclose(f);
+    return -3;
+  }
+  int64_t n = hi - lo + 1;
+  int rc = 0;
+  if (bits64) {
+    if ((int64_t)std::fread(offsets_out, 8, n, f) != n) rc = -2;
+  } else {
+    std::vector<int32_t> tmp(n);
+    if ((int64_t)std::fread(tmp.data(), 4, n, f) != n) rc = -2;
+    else for (int64_t i = 0; i < n; ++i) offsets_out[i] = tmp[i];
+  }
+  std::fclose(f);
+  return rc;
+}
+
+int cv_vite_edges(const char* path, int bits64, int64_t nv, int64_t e0,
+                  int64_t e1, int64_t* tails_out, double* weights_out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  const int64_t esz = bits64 ? 8 : 4;
+  const int64_t rec = bits64 ? 16 : 8;
+  const int64_t base = 2 * esz + (nv + 1) * esz + e0 * rec;
+  if (std::fseek(f, (long)base, SEEK_SET) != 0) { std::fclose(f); return -3; }
+  int64_t n = e1 - e0;
+  std::vector<char> buf(n * rec);
+  if ((int64_t)std::fread(buf.data(), rec, n, f) != n) {
+    std::fclose(f);
+    return -2;
+  }
+  std::fclose(f);
+  if (bits64) {
+    struct E { int64_t t; double w; };
+    const E* e = (const E*)buf.data();
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+      tails_out[i] = e[i].t;
+      weights_out[i] = e[i].w;
+    }
+  } else {
+    struct E { int32_t t; float w; };
+    const E* e = (const E*)buf.data();
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < n; ++i) {
+      tails_out[i] = e[i].t;
+      weights_out[i] = e[i].w;
+    }
+  }
+  return 0;
+}
+
+int cv_vite_write(const char* path, int bits64, int64_t nv, int64_t ne,
+                  const int64_t* offsets, const int64_t* tails,
+                  const double* weights) {
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  int rc = 0;
+  if (bits64) {
+    int64_t h[2] = {nv, ne};
+    if (std::fwrite(h, 8, 2, f) != 2) rc = -2;
+    if (!rc && (int64_t)std::fwrite(offsets, 8, nv + 1, f) != nv + 1) rc = -2;
+    if (!rc) {
+      struct E { int64_t t; double w; };
+      std::vector<E> buf(ne);
+#pragma omp parallel for schedule(static)
+      for (int64_t i = 0; i < ne; ++i) buf[i] = {tails[i], weights[i]};
+      if ((int64_t)std::fwrite(buf.data(), 16, ne, f) != ne) rc = -2;
+    }
+  } else {
+    int32_t h[2] = {(int32_t)nv, (int32_t)ne};
+    if (std::fwrite(h, 4, 2, f) != 2) rc = -2;
+    if (!rc) {
+      std::vector<int32_t> o32(nv + 1);
+      for (int64_t i = 0; i <= nv; ++i) o32[i] = (int32_t)offsets[i];
+      if ((int64_t)std::fwrite(o32.data(), 4, nv + 1, f) != nv + 1) rc = -2;
+    }
+    if (!rc) {
+      struct E { int32_t t; float w; };
+      std::vector<E> buf(ne);
+#pragma omp parallel for schedule(static)
+      for (int64_t i = 0; i < ne; ++i)
+        buf[i] = {(int32_t)tails[i], (float)weights[i]};
+      if ((int64_t)std::fwrite(buf.data(), 8, ne, f) != ne) rc = -2;
+    }
+  }
+  std::fclose(f);
+  return rc;
+}
+
+// ---------------------------------------------------------------------------
+// Edge-balanced partition: greedy scan of the offset array assigning
+// contiguous vertex ranges of ~ne/nparts edges each (role of balanceEdges,
+// /root/reference/distgraph.cpp:22-66, reached via the -b flag).
+void cv_balanced_parts(int64_t nv, const int64_t* offsets, int64_t nparts,
+                       int64_t* parts_out) {
+  const int64_t ne = offsets[nv];
+  parts_out[0] = 0;
+  // Cuts start at 1 (shard 0 is never empty), matching the Python
+  // balanced_parts searchsorted-over-offsets[1:] semantics even when a
+  // target is 0 (ne < nparts).
+  int64_t v = 1;
+  for (int64_t p = 1; p < nparts; ++p) {
+    const int64_t target = (ne * p) / nparts;
+    while (v < nv && offsets[v] < target) ++v;
+    parts_out[p] = v;
+  }
+  parts_out[nparts] = nv;
+}
+
+int cv_openmp_threads(void) {
+#if defined(_OPENMP)
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

@@ -36,9 +36,9 @@ def test_coloring_no_conflicts(maker):
     src, dst = _graph_arrays(g)
     colors, n_colors = multi_hash_coloring(src, dst, g.num_vertices, n_hash=4)
     assert count_conflicts(src, dst, g.num_vertices, colors) == 0
-    # coverage target: >= 70% colored (coloring.cpp:23)
-    frac = (colors >= 0).sum() / g.num_vertices
-    assert frac >= 0.70
+    # coverage target: >= floor(70% of nv) colored (coloring.cpp:23; the
+    # loop's integer target, so exact-hit counts like 358/512 pass)
+    assert (colors >= 0).sum() >= (g.num_vertices * 70) // 100
     assert n_colors > 0
     assert colors.max() < n_colors
 

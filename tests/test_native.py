@@ -1,0 +1,188 @@
+"""Native host-runtime parity tests.
+
+Every native entry point (native/cuvite_native.cpp via cuvite_tpu.native)
+must be bit-identical to its pure-numpy fallback — the library is an
+accelerator, not a semantic variant.  Skipped wholesale when the library
+cannot be built/loaded (e.g. no compiler in the deployment image).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from cuvite_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+def _random_edges(ne, nv, seed, self_loops=True, dups=True):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, nv, size=ne)
+    dst = rng.integers(0, nv, size=ne)
+    if not self_loops:
+        dst = np.where(src == dst, (dst + 1) % nv, dst)
+    if dups:
+        src[: ne // 4] = src[ne // 2 : ne // 2 + ne // 4]
+        dst[: ne // 4] = dst[ne // 2 : ne // 2 + ne // 4]
+    w = rng.random(ne)
+    return src, dst, w
+
+
+@pytest.mark.parametrize("symmetrize", [True, False])
+@pytest.mark.parametrize("seed", [0, 7])
+def test_build_csr_matches_numpy(symmetrize, seed):
+    from cuvite_tpu.core.graph import Graph
+
+    nv, ne = 257, 4096
+    src, dst, w = _random_edges(ne, nv, seed)
+    off_n, tails_n, w_n = native.build_csr(nv, src, dst, w, symmetrize)
+    # Force the numpy path (edge count below the native threshold).
+    g = Graph.from_edges(nv, src, dst, weights=w, symmetrize=symmetrize)
+    assert np.array_equal(off_n, g.offsets)
+    assert np.array_equal(tails_n, g.tails)
+    # Weight sums accumulate duplicates in the same (input) order on both
+    # paths, so equality after the policy-dtype cast is exact, not
+    # approximate (native returns the raw f64 sums).
+    assert np.array_equal(w_n.astype(g.weights.dtype), g.weights)
+
+
+def test_build_csr_rejects_out_of_range():
+    with pytest.raises(ValueError):
+        native.build_csr(4, np.array([0, 5]), np.array([1, 2]),
+                         np.ones(2), True)
+
+
+def test_from_edges_uses_native_above_threshold():
+    """Above the 2^16-edge threshold Graph.from_edges routes through the
+    native builder; result must equal the numpy path bit-for-bit."""
+    from cuvite_tpu.core.graph import Graph
+
+    nv, ne = 1000, (1 << 16) + 11
+    src, dst, w = _random_edges(ne, nv, 3)
+    g_native = Graph.from_edges(nv, src, dst, weights=w)
+    os.environ["CUVITE_NO_NATIVE"] = "1"
+    native._LIB = None
+    try:
+        g_numpy = Graph.from_edges(nv, src, dst, weights=w)
+    finally:
+        del os.environ["CUVITE_NO_NATIVE"]
+        native._LIB = None
+    assert np.array_equal(g_native.offsets, g_numpy.offsets)
+    assert np.array_equal(g_native.tails, g_numpy.tails)
+    assert np.array_equal(g_native.weights, g_numpy.weights)
+
+
+@pytest.mark.parametrize("scale,ne", [(8, 1 << 11), (12, 3000)])
+def test_rmat_matches_numpy(scale, ne):
+    from cuvite_tpu.io.generate import rmat_edges_numpy
+
+    s_n, d_n = native.rmat_edges(scale, ne, 1, 0.57, 0.19, 0.19)
+    s_p, d_p = rmat_edges_numpy(scale, ne, 1, 0.57, 0.19, 0.19)
+    assert np.array_equal(s_n, s_p)
+    assert np.array_equal(d_n, d_p)
+    assert s_n.min() >= 0 and s_n.max() < (1 << scale)
+
+
+def test_rmat_is_skewed():
+    """R-MAT must produce a heavy-tailed degree distribution (sanity that
+    the quadrant recursion actually biases, not uniform noise)."""
+    s, d = native.rmat_edges(12, 1 << 14, 1, 0.57, 0.19, 0.19)
+    deg = np.bincount(np.concatenate([s, d]), minlength=1 << 12)
+    assert deg.max() > 8 * max(deg.mean(), 1)
+
+
+@pytest.mark.parametrize("bits64", [True, False])
+def test_vite_native_roundtrip(tmp_path, bits64):
+    from cuvite_tpu.core.graph import Graph
+    from cuvite_tpu.core.types import default_policy, wide_policy
+    from cuvite_tpu.io.vite import read_vite, write_vite
+
+    nv, ne = 300, 70000  # above the native read/write threshold
+    src, dst, w = _random_edges(ne, nv, 5)
+    w = np.round(w * 16) / 16  # exact in float32 for the 32-bit format
+    policy = wide_policy() if bits64 else default_policy()
+    g = Graph.from_edges(nv, src, dst, weights=w, policy=policy)
+    p = str(tmp_path / "g.bin")
+    write_vite(p, g, bits64=bits64)  # native write
+    g2 = read_vite(p, bits64=bits64)  # native read
+    os.environ["CUVITE_NO_NATIVE"] = "1"
+    native._LIB = None
+    try:
+        g3 = read_vite(p, bits64=bits64)  # numpy memmap read
+    finally:
+        del os.environ["CUVITE_NO_NATIVE"]
+        native._LIB = None
+    for a, b in ((g2, g) , (g3, g)):
+        assert np.array_equal(a.offsets, b.offsets)
+        assert np.array_equal(a.tails, b.tails)
+        assert np.array_equal(a.weights, b.weights)
+
+
+def test_vite_native_vertex_range(tmp_path):
+    from cuvite_tpu.core.graph import Graph
+    from cuvite_tpu.io.vite import read_vite, write_vite
+
+    nv, ne = 128, 70000
+    src, dst, w = _random_edges(ne, nv, 9)
+    g = Graph.from_edges(nv, src, dst, weights=w)
+    p = str(tmp_path / "g.bin")
+    write_vite(p, g)
+    lo, hi = 32, 96
+    part = read_vite(p, vertex_range=(lo, hi))
+    assert part.num_vertices == hi - lo
+    e0, e1 = int(g.offsets[lo]), int(g.offsets[hi])
+    assert np.array_equal(part.offsets, g.offsets[lo : hi + 1] - e0)
+    assert np.array_equal(part.tails, g.tails[e0:e1])
+
+
+def test_balanced_parts_matches_python():
+    from cuvite_tpu.core.distgraph import balanced_parts
+    from cuvite_tpu.core.graph import Graph
+
+    nv, ne = 500, 120000
+    src, dst, w = _random_edges(ne, nv, 11)
+    g = Graph.from_edges(nv, src, dst, weights=w)
+    for nparts in (2, 4, 7):
+        p_py = balanced_parts(g, nparts)
+        p_nat = native.balanced_parts(g.offsets, nparts)
+        assert np.array_equal(p_py, p_nat)
+
+
+def test_balanced_parts_tiny_graph_matches_python():
+    """ne < nparts drives some edge targets to 0; both paths must agree on
+    the degenerate cuts (shard 0 never empty)."""
+    from cuvite_tpu.core.distgraph import balanced_parts
+    from cuvite_tpu.core.graph import Graph
+
+    g = Graph.from_edges(10, np.array([0, 3]), np.array([1, 4]))
+    for nparts in (3, 8):
+        assert np.array_equal(balanced_parts(g, nparts),
+                              native.balanced_parts(g.offsets, nparts))
+
+
+def test_coarsen_native_matches_numpy():
+    """coarsen_graph must be bit-identical with and without the native
+    library (same duplicate-accumulation order), including f64 weights."""
+    from cuvite_tpu.coarsen.rebuild import coarsen_graph, renumber_communities
+    from cuvite_tpu.core.graph import Graph
+    from cuvite_tpu.core.types import wide_policy
+
+    nv, ne = 400, 40000  # slab 2*ne > 2^16 -> native path eligible
+    src, dst, w = _random_edges(ne, nv, 13)
+    g = Graph.from_edges(nv, src, dst, weights=w, policy=wide_policy())
+    comm = (np.arange(nv) * 7919) % 37
+    dense, nc = renumber_communities(comm)
+    cg_native = coarsen_graph(g, dense, nc)
+    os.environ["CUVITE_NO_NATIVE"] = "1"
+    native._LIB = None
+    try:
+        cg_numpy = coarsen_graph(g, dense, nc)
+    finally:
+        del os.environ["CUVITE_NO_NATIVE"]
+        native._LIB = None
+    assert np.array_equal(cg_native.offsets, cg_numpy.offsets)
+    assert np.array_equal(cg_native.tails, cg_numpy.tails)
+    assert np.array_equal(cg_native.weights, cg_numpy.weights)
