@@ -41,36 +41,10 @@ def coarsen_graph(
     policy = policy or graph.policy
     src = dense_comm[graph.sources()]
     dst = dense_comm[graph.tails.astype(np.int64)]
-    w = graph.weights.astype(np.float64)
-    from cuvite_tpu import native
-
-    if len(src) >= (1 << 16) and native.available():
-        # The slab already holds both edge directions, so aggregation is a
-        # plain (src, dst) coalesce — cv_build_csr with symmetrize off.
-        offsets, tails, wsum = native.build_csr(nc, src, dst, w,
-                                                symmetrize=False)
-    else:
-        # Same coalesce in numpy: stable sort by (src, dst), then sum
-        # duplicates in input order — the accumulation-order contract shared
-        # with cv_build_csr, so native and fallback agree bit-for-bit (a
-        # scipy coo->csr coalesce would sum in a different order).
-        key = src * np.int64(nc) + dst
-        order = np.argsort(key, kind="stable")
-        key_s, w_s = key[order], w[order]
-        uniq = np.ones(len(key_s), dtype=bool)
-        uniq[1:] = key_s[1:] != key_s[:-1]
-        seg_ids = np.cumsum(uniq) - 1
-        n_uniq = int(seg_ids[-1]) + 1 if len(seg_ids) else 0
-        wsum = np.zeros(n_uniq, dtype=np.float64)
-        np.add.at(wsum, seg_ids, w_s)
-        key_u = key_s[uniq]
-        tails = key_u % nc
-        counts = np.bincount(key_u // nc, minlength=nc)
-        offsets = np.zeros(nc + 1, dtype=np.int64)
-        np.cumsum(counts, out=offsets[1:])
-    return Graph(
-        offsets=offsets,
-        tails=tails.astype(policy.vertex_dtype),
-        weights=wsum.astype(policy.weight_dtype),
-        policy=policy,
+    # The slab already holds both edge directions, so aggregation is a
+    # plain (src, dst) coalesce — from_edges without symmetrization (which
+    # itself dispatches to the native builder above its size threshold).
+    return Graph.from_edges(
+        nc, src, dst, weights=graph.weights.astype(np.float64),
+        symmetrize=False, policy=policy,
     )

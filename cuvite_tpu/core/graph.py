@@ -95,7 +95,7 @@ class Graph:
             w = np.asarray(weights, dtype=np.float64)
         from cuvite_tpu import native
 
-        if len(src) >= (1 << 16) and native.available():
+        if len(src) >= native.MIN_NATIVE_EDGES and native.available():
             offsets, tails, wsum = native.build_csr(
                 num_vertices, src, dst, w, symmetrize
             )

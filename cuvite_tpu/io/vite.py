@@ -76,7 +76,7 @@ def read_vite(
         )
     from cuvite_tpu import native
 
-    if (e1 - e0) >= (1 << 16) and native.available():
+    if (e1 - e0) >= native.MIN_NATIVE_EDGES and native.available():
         # Native bulk read: one sequential fread + parallel deinterleave
         # (the numpy memmap path does two strided passes over the edge
         # records).  Offsets were already read and validated above.
@@ -111,7 +111,7 @@ def write_vite(path: str, graph: Graph, bits64: bool = True) -> None:
     ne = graph.num_edges
     from cuvite_tpu import native
 
-    if ne >= (1 << 16) and native.available():
+    if ne >= native.MIN_NATIVE_EDGES and native.available():
         native.vite_write(
             path, bits64, graph.offsets,
             graph.tails.astype(np.int64),

@@ -1,0 +1,3 @@
+"""Pallas TPU kernels for the Louvain hot ops."""
+
+from cuvite_tpu.kernels.row_argmax import row_argmax_pallas  # noqa: F401

@@ -1,0 +1,133 @@
+"""Pallas TPU kernel: neighbor-community dedup + modularity-gain argmax for
+one degree bucket of the Louvain sweep.
+
+Role: the narrow-degree classes of the per-vertex inner loop — the TPU
+counterpart of the reference GPU's thread-per-vertex dedup/argmax kernels
+(distGetMaxIndex, /root/reference/louvain_cuda.cu:1190-1346, and
+computeMaxIndex, :641-876).  The XLA fallback (`_row_argmax` in
+cuvite_tpu/louvain/bucketed.py) materializes the [rows, D] aggregation
+intermediates in HBM; this kernel keeps the whole per-tile computation in
+VMEM and writes only the three per-row result vectors.
+
+Layout: the bucket is TRANSPOSED to [D, N] so the lane dimension runs
+across bucket rows (N = padded row count, a multiple of the 128-lane tile)
+and the all-pairs dedup unrolls over the small static D in the sublane
+dimension.  Per candidate slot j:
+
+    wagg_j  = sum_k  w_k   where c_k == c_j          (duplicate aggregation)
+    dup_j   = any_{k<j} c_k == c_j                   (j is not the leader)
+    valid_j = !dup_j and c_j != curr
+    gain_j  = 2*(wagg_j - eix) - 2*vdeg*(ay_j - ax)*const
+                                   (louvain.cpp:2228 formula; ay pre-gathered)
+    best    = running argmax over j, ties -> smaller community id
+                                   (louvain.cpp:2230-2238 tie-break)
+
+plus counter0 = sum of weights into the current community (incl. self
+edges), which the caller turns into eix for the next stage.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+DEFAULT_TILE_N = 512
+
+
+def _kernel(const_ref, cT_ref, wT_ref, ayT_ref, curr_ref, vdeg_ref, sl_ref,
+            ax_ref, bc_ref, bg_ref, c0_ref, *, sentinel: int, width: int):
+    c = cT_ref[:]          # [D, T] int32 neighbor communities
+    w = wT_ref[:]          # [D, T] f32 edge weights
+    ay = ayT_ref[:]        # [D, T] f32 comm_deg of each candidate
+    curr = curr_ref[:]     # [1, T] int32 current community
+    vdeg = vdeg_ref[:]     # [1, T] f32 weighted degree k_i
+    sl = sl_ref[:]         # [1, T] f32 self-loop weight of the vertex
+    ax = ax_ref[:]         # [1, T] f32 comm_deg[curr] - k_i
+    const = const_ref[0]   # f32 1/(2m)
+
+    wdt = w.dtype
+    is_cc = c == curr
+    zero = jnp.zeros_like(w)
+    c0 = jnp.sum(jnp.where(is_cc, w, zero), axis=0, keepdims=True)
+    c0_ref[:] = c0
+    # A vertex's weight into its current community comes entirely from its
+    # own bucket row, so eix (counter0 minus self-loops) is row-local.
+    eix = c0 - sl
+
+    neg_inf = jnp.full(curr.shape, -jnp.inf, dtype=wdt)
+    bg = neg_inf
+    bc = jnp.full(curr.shape, sentinel, dtype=c.dtype)
+    two_vdeg_const = 2.0 * vdeg * const
+    for j in range(width):
+        cj = c[j : j + 1, :]
+        eq = c == cj
+        wagg_j = jnp.sum(jnp.where(eq, w, zero), axis=0, keepdims=True)
+        if j > 0:
+            dup_j = jnp.any(eq[:j, :], axis=0, keepdims=True)
+            valid_j = (~dup_j) & (~is_cc[j : j + 1, :])
+        else:
+            valid_j = ~is_cc[j : j + 1, :]
+        gain_j = 2.0 * (wagg_j - eix) \
+            - two_vdeg_const * (ay[j : j + 1, :] - ax)
+        gain_j = jnp.where(valid_j, gain_j, neg_inf)
+        better = gain_j > bg
+        tie = valid_j & (gain_j == bg)
+        bc = jnp.where(better, cj, jnp.where(tie, jnp.minimum(bc, cj), bc))
+        bg = jnp.maximum(bg, gain_j)
+    bc_ref[:] = bc
+    bg_ref[:] = bg
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("sentinel", "tile_n", "interpret"),
+)
+def row_argmax_pallas(cT, wT, ayT, curr, vdeg, sl, ax, constant, *,
+                      sentinel: int, tile_n: int = DEFAULT_TILE_N,
+                      interpret: bool = False):
+    """Run the bucket kernel.
+
+    cT/wT/ayT: [D, N] transposed bucket matrices; curr/vdeg/sl/ax: [N]
+    (sl = per-vertex self-loop weight); constant: scalar.  N must be a
+    multiple of ``tile_n`` (bucket row counts are padded to powers of two
+    >= 128 by the runner for this path).  Returns
+    (best_c [N] int, best_gain [N], counter0 [N]).
+    """
+    D, N = cT.shape
+    tile = min(tile_n, N)
+    assert N % tile == 0 and tile % LANE == 0, (N, tile)
+    grid = (N // tile,)
+
+    mat_spec = pl.BlockSpec((D, tile), lambda i: (0, i),
+                            memory_space=pltpu.VMEM)
+    vec_spec = pl.BlockSpec((1, tile), lambda i: (0, i),
+                            memory_space=pltpu.VMEM)
+    out_shapes = (
+        jax.ShapeDtypeStruct((1, N), cT.dtype),
+        jax.ShapeDtypeStruct((1, N), wT.dtype),
+        jax.ShapeDtypeStruct((1, N), wT.dtype),
+    )
+    kernel = functools.partial(_kernel, sentinel=sentinel, width=D)
+    bc, bg, c0 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            mat_spec, mat_spec, mat_spec,
+            vec_spec, vec_spec, vec_spec, vec_spec,
+        ],
+        out_specs=(vec_spec, vec_spec, vec_spec),
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(
+        jnp.reshape(constant, (1,)).astype(wT.dtype),
+        cT, wT, ayT,
+        curr.reshape(1, N), vdeg.reshape(1, N), sl.reshape(1, N),
+        ax.reshape(1, N),
+    )
+    return bc.reshape(N), bg.reshape(N), c0.reshape(N)

@@ -344,7 +344,7 @@ def _rows_chunked(cmat, w_mat, curr, vdeg_v, eix_v, comm_deg, constant,
 
 def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
                   constant, *, nv_total, sentinel, accum_dtype=None,
-                  axis_name=None):
+                  axis_name=None, pallas_flags=(), pallas_interpret=False):
     """Full Louvain sweep over one shard using the bucketed engine.
 
     ``bucket_arrays`` is a tuple of (verts, dst_mat, w_mat) triples (one per
@@ -352,6 +352,11 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
     heavy-vertex edges (may be empty-padded).  Returns (target, modularity,
     n_moved) with semantics identical to louvain_step_local — the two
     engines are interchangeable and tested for equal outputs.
+
+    ``pallas_flags`` (one bool per bucket) routes flagged degree classes
+    through the Pallas row-argmax kernel (cuvite_tpu/kernels/row_argmax.py);
+    those buckets' dst/w matrices must be stored TRANSPOSED [D, Nb] with Nb
+    a multiple of 128 (the runner's ``engine='pallas'`` upload does this).
 
     With ``axis_name`` the function runs SPMD inside shard_map: ``comm`` /
     ``vdeg`` / ``self_loop`` are this shard's slices, ``dst`` ids are global
@@ -391,10 +396,32 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
     counter0 = counter0 + c0_heavy
     # bucket counter0 values are produced by the row pass below.
 
+    # Pallas-routed buckets are self-contained (eix is row-local: the
+    # kernel derives it from its own counter0 and the self-loop weight), so
+    # they finalize in one pass; XLA buckets keep the two-pass structure
+    # (counter0 for all rows first, then argmax with eix).
+    is_pallas = (list(pallas_flags) if pallas_flags
+                 else [False] * len(bucket_arrays))
     row_results = []
-    for verts, dst_mat, w_mat in bucket_arrays:
+    for i, (verts, dst_mat, w_mat) in enumerate(bucket_arrays):
+        safe_v = jnp.minimum(verts, nv_local - 1)
+        curr = jnp.take(comm, safe_v)
+        if is_pallas[i]:
+            from cuvite_tpu.kernels.row_argmax import row_argmax_pallas
+
+            cmat_t = jnp.take(comm_full, dst_mat)   # [D, Nb]
+            vdeg_v = jnp.take(vdeg, safe_v)
+            bc, bg, c0_rows = row_argmax_pallas(
+                cmat_t, w_mat, jnp.take(comm_deg, cmat_t),
+                curr, vdeg_v, jnp.take(self_loop, safe_v),
+                jnp.take(comm_deg, curr) - vdeg_v, constant,
+                sentinel=sentinel, interpret=pallas_interpret,
+            )
+            counter0 = counter0.at[verts].add(c0_rows, mode="drop")
+            best_c = best_c.at[verts].set(bc.astype(vdt), mode="drop")
+            best_gain = best_gain.at[verts].set(bg, mode="drop")
+            continue
         cmat = jnp.take(comm_full, dst_mat)
-        curr = jnp.take(comm, jnp.minimum(verts, nv_local - 1))
         c0_rows = jnp.sum(
             jnp.where(cmat == curr[:, None], w_mat, 0.0), axis=1
         ).astype(wdt)

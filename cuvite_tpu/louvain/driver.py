@@ -37,6 +37,7 @@ from cuvite_tpu.core.types import (
     TERMINATION_PHASE_COUNT,
 )
 from cuvite_tpu.louvain.bucketed import (
+    QUADRATIC_MAX_WIDTH,
     BucketPlan,
     bucketed_step,
     build_stacked_plans,
@@ -116,11 +117,15 @@ def _get_step(mesh, nv_total: int, accum_dtype) -> object:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("nv_total", "sentinel", "accum_dtype")
+    jax.jit,
+    static_argnames=("nv_total", "sentinel", "accum_dtype", "pallas_flags",
+                     "pallas_interpret"),
 )
 def _bucketed_jit(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
-                  constant, *, nv_total, sentinel, accum_dtype):
-    call = _bucketed_call(nv_total, sentinel, accum_dtype)
+                  constant, *, nv_total, sentinel, accum_dtype,
+                  pallas_flags=(), pallas_interpret=False):
+    call = _bucketed_call(nv_total, sentinel, accum_dtype, pallas_flags,
+                          pallas_interpret)
     return call(comm, (bucket_arrays, heavy_arrays, self_loop, vdeg,
                        constant))
 
@@ -162,12 +167,14 @@ def _run_phase_loop(extra, comm0, threshold, lower, *, call, max_iters):
 
 
 @functools.lru_cache(maxsize=None)
-def _bucketed_call(nv_total, sentinel, accum_dtype):
+def _bucketed_call(nv_total, sentinel, accum_dtype, pallas_flags=(),
+                   pallas_interpret=False):
     def call(comm, extra):
         buckets, heavy, self_loop, vdeg, constant = extra
         return bucketed_step(
             buckets, heavy, self_loop, comm, vdeg, constant,
             nv_total=nv_total, sentinel=sentinel, accum_dtype=accum_dtype,
+            pallas_flags=pallas_flags, pallas_interpret=pallas_interpret,
         )
 
     return call
@@ -205,10 +212,10 @@ class PhaseRunner:
     """
 
     def __init__(self, dg: DistGraph, mesh=None, engine: str = "sort"):
-        if engine not in ("sort", "bucketed"):
-            raise ValueError(f"unknown engine {engine!r}; use 'sort' or "
-                             "'bucketed' ('auto' is resolved by "
-                             "louvain_phases)")
+        if engine not in ("sort", "bucketed", "pallas"):
+            raise ValueError(f"unknown engine {engine!r}; use 'sort', "
+                             "'bucketed' or 'pallas' ('auto' is resolved "
+                             "by louvain_phases)")
         self.dg = dg
         self.mesh = mesh
         self.engine = engine
@@ -220,6 +227,10 @@ class PhaseRunner:
         comm0 = np.arange(nv_total, dtype=vdt)
         adt = _device_dtype(dg.graph.policy.accum_dtype)
         multi = mesh is not None and int(np.prod(mesh.devices.shape)) > 1
+        if engine == "pallas" and multi:
+            # The Pallas upload layout is single-shard for now; the SPMD
+            # path keeps the XLA bucketed step.
+            engine = "bucketed"
         if engine == "bucketed" and multi:
             # SPMD bucketed path: per-shard plans padded to common shapes,
             # sharded along the mesh; comm pull = all_gather inside the step.
@@ -255,7 +266,7 @@ class PhaseRunner:
             self._call = _bucketed_sharded_call(step_fn)
             self._bucket_extra = (buckets, heavy, self_loop)
             self.src = self.dst = self.w = None
-        elif engine == "bucketed":
+        elif engine in ("bucketed", "pallas"):
             # The bucket matrices replace the edge slab entirely: don't
             # upload src/dst/w (they would double edge memory on device).
             sh = dg.shards[0]
@@ -264,12 +275,37 @@ class PhaseRunner:
                 nv_local=dg.nv_pad, base=0,
             )
             sentinel = int(np.iinfo(vdt).max)
-            buckets = tuple(
-                (jnp.asarray(b.verts.astype(vdt)),
-                 jnp.asarray(b.dst.astype(vdt)),
-                 jnp.asarray(b.w.astype(wdt)))
-                for b in plan.buckets
-            )
+            use_pallas = engine == "pallas"
+            buckets = []
+            flags = []
+            for b in plan.buckets:
+                if use_pallas and b.width <= QUADRATIC_MAX_WIDTH:
+                    # Kernel layout: transposed [D, Nb], Nb a multiple of
+                    # the 128-lane tile (pad rows with dropped sentinels).
+                    nb = len(b.verts)
+                    nb_pad = max(nb, 128)
+                    verts = np.full(nb_pad, dg.nv_pad, dtype=np.int64)
+                    verts[:nb] = b.verts
+                    dmat = np.zeros((nb_pad, b.width), dtype=b.dst.dtype)
+                    wmat = np.zeros((nb_pad, b.width), dtype=b.w.dtype)
+                    dmat[:nb] = b.dst
+                    wmat[:nb] = b.w
+                    buckets.append((
+                        jnp.asarray(verts.astype(vdt)),
+                        jnp.asarray(np.ascontiguousarray(
+                            dmat.T.astype(vdt))),
+                        jnp.asarray(np.ascontiguousarray(
+                            wmat.T.astype(wdt))),
+                    ))
+                    flags.append(True)
+                else:
+                    buckets.append((jnp.asarray(b.verts.astype(vdt)),
+                                    jnp.asarray(b.dst.astype(vdt)),
+                                    jnp.asarray(b.w.astype(wdt))))
+                    flags.append(False)
+            buckets = tuple(buckets)
+            flags = tuple(flags)
+            interp = jax.default_backend() != "tpu"
             heavy = (jnp.asarray(plan.heavy_src.astype(vdt)),
                      jnp.asarray(plan.heavy_dst.astype(vdt)),
                      jnp.asarray(plan.heavy_w.astype(wdt)))
@@ -280,10 +316,12 @@ class PhaseRunner:
                 return _bucketed_jit(
                     buckets, heavy, self_loop, comm, vdeg_, constant,
                     nv_total=nv_total, sentinel=sentinel, accum_dtype=adt_np,
+                    pallas_flags=flags, pallas_interpret=interp,
                 )
 
             self._step = _step
-            self._call = _bucketed_call(nv_total, sentinel, adt_np)
+            self._call = _bucketed_call(nv_total, sentinel, adt_np, flags,
+                                        interp)
             self._bucket_extra = (buckets, heavy, self_loop)
             self.src = self.dst = self.w = None
         else:
@@ -291,9 +329,10 @@ class PhaseRunner:
             self._call = _step_call(self._step)
             self._bucket_extra = None
         self.real_mask = dg.vertex_mask()
+        slab_engine = self._bucket_extra is None  # bucket matrices replace it
         if multi:
             assert dg.nshards == int(np.prod(mesh.devices.shape))
-            if engine != "bucketed":
+            if slab_engine:
                 src, dst, w = dg.stacked_edges()
                 self.src = shard_1d(mesh, src.astype(vdt))
                 self.dst = shard_1d(mesh, dst.astype(vdt))
@@ -303,7 +342,7 @@ class PhaseRunner:
             self.real_mask_dev = shard_1d(mesh, self.real_mask)
         else:
             assert dg.nshards == 1
-            if engine != "bucketed":
+            if slab_engine:
                 src, dst, w = dg.stacked_edges()
                 self.src = jnp.asarray(src.astype(vdt))
                 self.dst = jnp.asarray(dst.astype(vdt))

@@ -21,6 +21,11 @@ import numpy as np
 
 _LIB = None  # None = not tried; False = unavailable; else CDLL
 
+# Minimum element count for routing through the native library; below this
+# the ctypes/copy overhead outweighs the win.  Shared by every dispatch
+# site (Graph.from_edges, read/write_vite).
+MIN_NATIVE_EDGES = 1 << 16
+
 
 def _repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.dirname(
@@ -59,9 +64,6 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.cv_vite_header.restype = ctypes.c_int
     lib.cv_vite_header.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                    ctypes.POINTER(i64), ctypes.POINTER(i64)]
-    lib.cv_vite_offsets.restype = ctypes.c_int
-    lib.cv_vite_offsets.argtypes = [ctypes.c_char_p, ctypes.c_int, i64, i64,
-                                    p_i64]
     lib.cv_vite_edges.restype = ctypes.c_int
     lib.cv_vite_edges.argtypes = [ctypes.c_char_p, ctypes.c_int, i64, i64,
                                   i64, p_i64, p_f64]
@@ -162,19 +164,6 @@ def vite_edges(path: str, bits64: bool, nv: int, e0: int, e1: int):
     if rc != 0:
         raise ValueError(f"{path}: edge read failed (rc={rc})")
     return tails[: e1 - e0], weights[: e1 - e0]
-
-
-def vite_read(path: str, bits64: bool, lo: int, hi: int, nv: int):
-    """Rows [lo, hi): re-based offsets + deinterleaved tails/weights."""
-    lib = _load()
-    assert lib is not None
-    offsets = np.empty(hi - lo + 1, dtype=np.int64)
-    rc = lib.cv_vite_offsets(path.encode(), int(bits64), lo, hi, offsets)
-    if rc != 0:
-        raise ValueError(f"{path}: offset read failed (rc={rc})")
-    e0, e1 = int(offsets[0]), int(offsets[-1])
-    tails, weights = vite_edges(path, bits64, nv, e0, e1)
-    return offsets - e0, tails, weights
 
 
 def vite_write(path: str, bits64: bool, offsets: np.ndarray,

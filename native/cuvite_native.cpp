@@ -213,32 +213,9 @@ int cv_vite_header(const char* path, int bits64, int64_t* nv_out,
   return rc;
 }
 
-// Reads rows [lo, hi) of the CSR: offsets re-based to 0 (nv_local+1 entries)
-// and the corresponding tail/weight slices, deinterleaved to
-// struct-of-arrays.  Buffers must be sized from a prior cv_vite_header +
-// offsets probe (cv_vite_offsets).  Returns 0 on success.
-int cv_vite_offsets(const char* path, int bits64, int64_t lo, int64_t hi,
-                    int64_t* offsets_out) {
-  FILE* f = std::fopen(path, "rb");
-  if (!f) return -1;
-  const int64_t esz = bits64 ? 8 : 4;
-  if (std::fseek(f, (long)(2 * esz + lo * esz), SEEK_SET) != 0) {
-    std::fclose(f);
-    return -3;
-  }
-  int64_t n = hi - lo + 1;
-  int rc = 0;
-  if (bits64) {
-    if ((int64_t)std::fread(offsets_out, 8, n, f) != n) rc = -2;
-  } else {
-    std::vector<int32_t> tmp(n);
-    if ((int64_t)std::fread(tmp.data(), 4, n, f) != n) rc = -2;
-    else for (int64_t i = 0; i < n; ++i) offsets_out[i] = tmp[i];
-  }
-  std::fclose(f);
-  return rc;
-}
-
+// Reads edge records [e0, e1) and deinterleaves them to struct-of-arrays
+// (the caller reads + validates the offsets itself, via memmap in
+// cuvite_tpu/io/vite.py).  Returns 0 on success.
 int cv_vite_edges(const char* path, int bits64, int64_t nv, int64_t e0,
                   int64_t e1, int64_t* tails_out, double* weights_out) {
   FILE* f = std::fopen(path, "rb");
