@@ -471,6 +471,71 @@ class PhaseRunner:
         return np.asarray(jax.device_get(past)), prev_mod, iters
 
 
+def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
+               max_phases, verbose):
+    """Single-shard fused execution: one device call for the whole
+    clustering (cuvite_tpu/louvain/fused.py), one host sync at the end."""
+    from cuvite_tpu.louvain.fused import fused_louvain
+
+    t_start = time.perf_counter()
+    dg = DistGraph.build(graph, 1, balanced=balanced)
+    sh = dg.shards[0]
+    nv_pad = dg.nv_pad
+    wdt = _device_dtype(graph.policy.weight_dtype)
+    adt = np.dtype(_device_dtype(graph.policy.accum_dtype)).name
+    max_p = 1 if one_phase else int(max_phases)
+    if threshold_cycling and not one_phase:
+        ths = np.array([threshold_for_phase(p) for p in range(max_p)],
+                       dtype=wdt)
+    else:
+        ths = np.full(max_p, threshold, dtype=wdt)
+    constant = jnp.asarray(1.0 / graph.total_edge_weight_twice(), dtype=wdt)
+
+    out = fused_louvain(
+        jnp.asarray(np.asarray(sh.src).astype(np.int32)),
+        jnp.asarray(np.asarray(sh.dst).astype(np.int32)),
+        jnp.asarray(np.asarray(sh.w).astype(wdt)),
+        jnp.asarray(ths),
+        constant,
+        jnp.asarray(dg.vertex_mask()),
+        nv_pad=nv_pad,
+        max_phases=max_p,
+        accum_dtype=adt,
+        cycling=bool(threshold_cycling and not one_phase),
+    )
+    (labels, prev_mod, n_phases, tot_iters, mod_hist, iter_hist,
+     nc_hist) = jax.device_get(out)
+    total_s = time.perf_counter() - t_start
+
+    n_phases = int(n_phases)
+    tot_iters = int(tot_iters)
+    comm_all = np.asarray(labels)[dg.old_to_pad]
+    dense_all, _ = renumber_communities(comm_all)
+    phases = []
+    nv_p = graph.num_vertices
+    for p in range(n_phases):
+        phases.append(PhaseStats(
+            phase=p, modularity=float(mod_hist[p]),
+            iterations=int(iter_hist[p]), num_vertices=nv_p,
+            # The fused engine relabels instead of aggregating, so every
+            # phase traverses the full edge slab.
+            num_edges=graph.num_edges,
+            seconds=total_s / max(n_phases, 1),
+        ))
+        nv_p = int(nc_hist[p])
+        if verbose:
+            st = phases[-1]
+            print(f"Level {st.phase}, Modularity: {st.modularity:.6f}, "
+                  f"Iterations: {st.iterations}, nv: {st.num_vertices}")
+    return LouvainResult(
+        communities=dense_all,
+        modularity=float(prev_mod) if n_phases else -1.0,
+        phases=phases,
+        total_iterations=tot_iters,
+        total_seconds=total_s,
+    )
+
+
 def louvain_phases(
     graph: Graph,
     nshards: int = 1,
@@ -503,6 +568,13 @@ def louvain_phases(
         mesh = make_mesh(nshards)
     if engine == "auto":
         engine = "bucketed"
+    if engine == "fused" and (
+        et_mode or coloring or vertex_ordering or mesh is not None
+        or nshards > 1
+    ):
+        # The fused program covers the default single-shard schedule; the
+        # per-phase drivers own the ET/coloring variants and SPMD.
+        engine = "bucketed"
 
     nv0 = graph.num_vertices
     comm_all = np.arange(nv0, dtype=np.int64)
@@ -512,6 +584,13 @@ def louvain_phases(
             communities=comm_all, modularity=0.0, phases=[],
             total_iterations=0, total_seconds=0.0,
         )
+    if engine == "fused":
+        return _run_fused(
+            graph, threshold=threshold, threshold_cycling=threshold_cycling,
+            one_phase=one_phase, balanced=balanced, max_phases=max_phases,
+            verbose=verbose,
+        )
+
     phases: list[PhaseStats] = []
     prev_mod = -1.0
     tot_iters = 0

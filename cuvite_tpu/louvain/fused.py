@@ -1,0 +1,208 @@
+"""Fully fused multi-phase Louvain: the ENTIRE clustering — iteration
+loops, convergence checks, coarsening, label composition — as ONE jitted
+device program.
+
+Rationale.  The reference's control flow re-enters the host every
+iteration (modularity check, louvain.cpp:541-546) and every phase
+(renumber + rebuild + redistribute, main.cpp:363-428).  On TPU each host
+entry is a device->host sync — expensive always, and catastrophically so
+over a remote-device link.  This driver moves the whole multi-phase loop
+(main.cpp:218-495) on device:
+
+  * inner iteration loop: lax.while_loop with the threshold check on
+    device (same semantics as PhaseRunner.run / _run_phase_loop);
+  * coarsening (distbuildNextLevelGraph, rebuild.cpp:430-454) becomes
+    RELABEL-ONLY: community ids stay in the padded vertex id space and
+    edge endpoints are rewritten to their communities.  No dense
+    renumbering is needed on device because renumbering is an
+    order-preserving bijection: every id comparison the algorithm makes
+    (argmax tie-break to the smaller id, the singleton-swap guard's
+    `best > comm`) gives identical results under original or dense ids.
+    Parallel edges stay unaggregated — Louvain is multigraph-invariant
+    (the (c1,c2) aggregate weight equals the sum over parallel edges),
+    which is what keeps every shape static across phases;
+  * cross-phase label composition (commAll, main.cpp:374-403) is a
+    device gather per phase.
+
+One host sync for the whole clustering: the final labels + per-phase
+stats come back in a single transfer.  Single-shard (the coarsened
+relabeling would need an edge re-shard collective for SPMD; the sharded
+engines in driver.py cover multi-chip).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from cuvite_tpu.core.types import MAX_TOTAL_ITERATIONS
+from cuvite_tpu.louvain.step import louvain_step_local
+from cuvite_tpu.ops import segment as seg
+
+
+@functools.lru_cache(maxsize=None)
+def _fused_step_call(nv_pad, accum_dtype):
+    """(comm, extra) adapter over louvain_step_local for _run_phase_loop
+    (lru-cached for stable static-arg identity)."""
+
+    def call(comm, extra):
+        src, dst, w, vdeg, constant = extra
+        out = louvain_step_local(
+            src, dst, w, comm, vdeg, constant,
+            nv_total=nv_pad, axis_name=None, accum_dtype=accum_dtype,
+        )
+        return out.target, out.modularity, out.n_moved
+
+    return call
+
+
+def _phase_iterations(src, dst, w, vdeg, constant, threshold, lower, *,
+                      nv_pad, accum_dtype, max_iters):
+    """Inner iteration loop of one phase: the same _run_phase_loop the
+    per-phase driver uses (single source of the convergence semantics),
+    with identity comm0 and the slab as the step extras."""
+    from cuvite_tpu.louvain.driver import _run_phase_loop
+
+    comm0 = jnp.arange(nv_pad, dtype=jnp.int32)
+    return _run_phase_loop(
+        (src, dst, w, vdeg, constant), comm0, threshold, lower,
+        call=_fused_step_call(nv_pad, accum_dtype), max_iters=max_iters,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("nv_pad", "max_phases", "accum_dtype", "cycling"),
+)
+def fused_louvain(src, dst, w, thresholds, constant, real_mask, *,
+                  nv_pad, max_phases, accum_dtype=None, cycling=False):
+    """Run the full multi-phase Louvain on device.
+
+    src/dst: [ne_pad] int32 — local == global ids (single shard), pad
+    entries have src == nv_pad, w == 0, and src sorted ascending.
+    thresholds: [max_phases] per-phase gain thresholds (the cycling
+    schedule or a constant).  real_mask: [nv_pad] bool, true for the
+    original graph's real vertices.
+
+    Returns (labels [nv_pad], modularity, n_phases, total_iters,
+    mod_hist [max_phases], iter_hist [max_phases], nc_hist [max_phases]).
+    """
+    wdt = w.dtype
+    labels0 = jnp.arange(nv_pad, dtype=jnp.int32)
+    mod_hist0 = jnp.zeros(max_phases, dtype=wdt)
+    iter_hist0 = jnp.zeros(max_phases, dtype=jnp.int32)
+    nc_hist0 = jnp.zeros(max_phases, dtype=jnp.int32)
+    lower = jnp.asarray(-1.0, dtype=wdt)
+
+    def count_comms(labels):
+        present = jnp.zeros(nv_pad, dtype=jnp.int32).at[
+            jnp.where(real_mask, labels, nv_pad)
+        ].set(1, mode="drop")
+        return jnp.sum(present)
+
+    def cond(state):
+        return ~state[-1]
+
+    def body(state):
+        (src, dst, w, labels, prev_mod, phase, tot_iters,
+         mod_hist, iter_hist, nc_hist, _, _done) = state
+        vdeg = seg.segment_sum(w, src, num_segments=nv_pad, sorted_ids=True)
+        th = thresholds[jnp.minimum(phase, max_phases - 1)]
+        past, mod, iters = _phase_iterations(
+            src, dst, w, vdeg, constant, th, lower,
+            nv_pad=nv_pad, accum_dtype=accum_dtype,
+            max_iters=MAX_TOTAL_ITERATIONS,
+        )
+        tot_iters = tot_iters + iters
+        gained = (mod - prev_mod) > th
+
+        # Relabel-only coarsening + label composition (selected only when
+        # the phase gained; while_loop bodies are uniform so the work runs
+        # either way, at most once wasted).
+        new_src = jnp.where(
+            src < nv_pad,
+            jnp.take(past, jnp.minimum(src, nv_pad - 1)),
+            jnp.int32(nv_pad),
+        )
+        new_dst = jnp.take(past, jnp.minimum(dst, nv_pad - 1))
+        order = jnp.argsort(new_src, stable=True)
+        new_labels = jnp.take(past, labels)
+
+        src2 = jnp.where(gained, jnp.take(new_src, order), src)
+        dst2 = jnp.where(gained, jnp.take(new_dst, order), dst)
+        w2 = jnp.where(gained, jnp.take(w, order), w)
+        labels2 = jnp.where(gained, new_labels, labels)
+        prev_mod2 = jnp.where(gained, jnp.maximum(mod, lower), prev_mod)
+
+        mod_hist = jnp.where(
+            gained, mod_hist.at[jnp.minimum(phase, max_phases - 1)].set(mod),
+            mod_hist)
+        iter_hist = jnp.where(
+            gained,
+            iter_hist.at[jnp.minimum(phase, max_phases - 1)].set(iters),
+            iter_hist)
+        nc_hist = jnp.where(
+            gained,
+            nc_hist.at[jnp.minimum(phase, max_phases - 1)].set(
+                count_comms(labels2)),
+            nc_hist)
+
+        phase2 = jnp.where(gained, phase + 1, phase)
+        done = (~gained) | (phase2 >= max_phases) \
+            | (tot_iters > MAX_TOTAL_ITERATIONS)
+        return (src2, dst2, w2, labels2, prev_mod2, phase2, tot_iters,
+                mod_hist, iter_hist, nc_hist, gained, done)
+
+    init = (src, dst, w, labels0, lower, jnp.int32(0), jnp.int32(0),
+            mod_hist0, iter_hist0, nc_hist0, jnp.bool_(False),
+            jnp.bool_(False))
+    (src_f, dst_f, w_f, labels, prev_mod, phase, tot_iters,
+     mod_hist, iter_hist, nc_hist, last_gained, _) = jax.lax.while_loop(
+        cond, body, init)
+
+    if cycling:
+        # Safety-net final 1e-6 pass, ONLY when the loop exited because a
+        # phase failed to gain (main.cpp:432-442) — an exit via the phase
+        # or iteration caps after a gaining phase runs no safety pass,
+        # matching the per-phase driver.
+        th_last = thresholds[jnp.minimum(phase, max_phases - 1)]
+        run_extra = (~last_gained) & (phase < 10) & (th_last > 1e-6) \
+            & (phase < max_phases)
+
+        def extra(args):
+            labels, prev_mod, tot_iters, mod_hist, iter_hist, nc_hist, \
+                phase = args
+            vdeg = seg.segment_sum(w_f, src_f, num_segments=nv_pad,
+                                   sorted_ids=True)
+            past, mod, iters = _phase_iterations(
+                src_f, dst_f, w_f, vdeg, constant,
+                jnp.asarray(1e-6, dtype=wdt), lower,
+                nv_pad=nv_pad, accum_dtype=accum_dtype,
+                max_iters=MAX_TOTAL_ITERATIONS,
+            )
+            tot_iters = tot_iters + iters
+            gained = (mod - prev_mod) > 1e-6
+            labels2 = jnp.where(gained, jnp.take(past, labels), labels)
+            slot = jnp.minimum(phase, max_phases - 1)
+            return (
+                labels2,
+                jnp.where(gained, jnp.maximum(mod, lower), prev_mod),
+                tot_iters,
+                jnp.where(gained, mod_hist.at[slot].set(mod), mod_hist),
+                jnp.where(gained, iter_hist.at[slot].set(iters), iter_hist),
+                jnp.where(gained, nc_hist.at[slot].set(count_comms(labels2)),
+                          nc_hist),
+                jnp.where(gained, phase + 1, phase),
+            )
+
+        (labels, prev_mod, tot_iters, mod_hist, iter_hist, nc_hist,
+         phase) = jax.lax.cond(
+            run_extra, extra, lambda a: a,
+            (labels, prev_mod, tot_iters, mod_hist, iter_hist, nc_hist,
+             phase),
+        )
+
+    return (labels, prev_mod, phase, tot_iters, mod_hist, iter_hist,
+            nc_hist)

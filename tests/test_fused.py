@@ -1,0 +1,78 @@
+"""Fused on-device multi-phase driver vs the per-phase host driver.
+
+The two execution strategies must produce identical clusterings: the fused
+program's relabel-only coarsening is an order-preserving relabeling of the
+host driver's dense renumber + aggregate, and every id comparison the
+algorithm makes is order-invariant.
+"""
+
+import numpy as np
+import pytest
+
+from cuvite_tpu.evaluate.modularity import modularity as mod_oracle
+from cuvite_tpu.io.generate import generate_rgg, generate_rmat
+from cuvite_tpu.louvain.driver import louvain_phases
+
+
+def test_fused_karate_identical(karate):
+    rb = louvain_phases(karate, engine="bucketed")
+    rf = louvain_phases(karate, engine="fused")
+    assert rf.modularity == pytest.approx(rb.modularity, abs=1e-6)
+    assert np.array_equal(rf.communities, rb.communities)
+    # Per-phase history survives the fused run.
+    assert [p.iterations for p in rf.phases] == \
+        [p.iterations for p in rb.phases]
+    assert [p.modularity for p in rf.phases] == pytest.approx(
+        [p.modularity for p in rb.phases], abs=1e-6)
+    # nc trajectory: phase p+1's vertex count = phase p's community count.
+    assert [p.num_vertices for p in rf.phases] == \
+        [p.num_vertices for p in rb.phases]
+
+
+def test_fused_two_cliques(two_cliques):
+    rf = louvain_phases(two_cliques, engine="fused")
+    assert rf.num_communities == 2
+    # Q = 2*(10/21 - (21/42)^2) = 0.452381 for two K5s + one bridge edge.
+    assert rf.modularity == pytest.approx(0.452381, abs=1e-4)
+
+
+@pytest.mark.parametrize("maker", [
+    lambda: generate_rmat(10, edge_factor=8, seed=4),
+    lambda: generate_rgg(1024, seed=1),
+])
+def test_fused_matches_host_driver(maker):
+    g = maker()
+    rb = louvain_phases(g, engine="bucketed")
+    rf = louvain_phases(g, engine="fused")
+    assert rf.modularity == pytest.approx(rb.modularity, abs=1e-5)
+    assert np.array_equal(rf.communities, rb.communities)
+    assert rf.total_iterations == rb.total_iterations
+
+
+def test_fused_one_phase(karate):
+    rb = louvain_phases(karate, engine="bucketed", one_phase=True)
+    rf = louvain_phases(karate, engine="fused", one_phase=True)
+    assert rf.modularity == pytest.approx(rb.modularity, abs=1e-6)
+    assert len(rf.phases) == 1
+
+
+def test_fused_threshold_cycling(karate):
+    rb = louvain_phases(karate, engine="bucketed", threshold_cycling=True)
+    rf = louvain_phases(karate, engine="fused", threshold_cycling=True)
+    assert rf.modularity == pytest.approx(rb.modularity, abs=1e-6)
+    assert np.array_equal(rf.communities, rb.communities)
+
+
+def test_fused_modularity_oracle(karate):
+    rf = louvain_phases(karate, engine="fused")
+    q = mod_oracle(karate, rf.communities)
+    assert q == pytest.approx(rf.modularity, abs=1e-4)
+
+
+def test_fused_falls_back_for_variants(karate):
+    """ET / coloring / SPMD requests silently use the per-phase driver."""
+    r = louvain_phases(karate, engine="fused", et_mode=1)
+    assert r.modularity > 0.38
+    r8 = louvain_phases(karate, engine="fused", nshards=8)
+    r1 = louvain_phases(karate, engine="fused")
+    assert np.array_equal(r8.communities, r1.communities)
