@@ -40,6 +40,7 @@ def main():
     scale = int(os.environ.get("BENCH_SCALE", "20"))
     ef = int(os.environ.get("BENCH_EF", "16"))
     kind = os.environ.get("BENCH_GRAPH", "rmat")
+    engine = os.environ.get("BENCH_ENGINE", "auto")
 
     from cuvite_tpu.io.generate import generate_rgg, generate_rmat
     from cuvite_tpu.louvain.driver import louvain_phases
@@ -58,11 +59,11 @@ def main():
     # in-memory jit cache and TEPS measures steady-state execution, not
     # XLA compilation (the reference likewise excludes one-time costs from
     # its clustering-time metric, main.cpp:499-518).
-    res = louvain_phases(graph)
+    res = louvain_phases(graph, engine=engine)
     del res
 
     t1 = time.perf_counter()
-    res = louvain_phases(graph, verbose=False)
+    res = louvain_phases(graph, engine=engine, verbose=False)
     wall = time.perf_counter() - t1
 
     traversed = sum(p.num_edges * p.iterations for p in res.phases)

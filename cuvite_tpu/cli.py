@@ -72,6 +72,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="distance-1 coloring with NC max colors")
     run.add_argument("--vertex-ordering", "-d", type=int, metavar="NC",
                      help="color-based vertex ordering with NC max colors")
+    run.add_argument("--engine", default="auto",
+                     choices=["auto", "sort", "bucketed", "pallas", "fused"],
+                     help="execution engine (auto = degree-bucketed)")
 
     out = p.add_argument_group("output")
     out.add_argument("--output", "-o", action="store_true",
@@ -83,6 +86,10 @@ def build_parser() -> argparse.ArgumentParser:
     out.add_argument("--just-process", "-j", action="store_true")
     out.add_argument("--json", action="store_true",
                      help="emit a machine-readable summary line")
+    out.add_argument("--trace", action="store_true",
+                     help="print a stage-time breakdown, TEPS and RSS "
+                          "high-water (the reference's per-stage "
+                          "MPI_Wtime/getrusage instrumentation)")
     out.add_argument("--quiet", action="store_true")
     return p
 
@@ -138,6 +145,9 @@ def main(argv=None) -> int:
     if args.just_process:
         return 0
 
+    from cuvite_tpu.utils.trace import Tracer
+
+    tracer = Tracer(enabled=args.trace)
     res = louvain_phases(
         graph,
         nshards=args.shards,
@@ -147,10 +157,14 @@ def main(argv=None) -> int:
         balanced=args.balanced,
         et_mode=args.early_term or 0,
         et_delta=args.et_delta,
+        engine=args.engine,
         coloring=args.coloring or 0,
         vertex_ordering=args.vertex_ordering or 0,
         verbose=not args.quiet,
+        tracer=tracer,
     )
+    if args.trace:
+        print(tracer.report())
 
     q = modularity(graph, res.communities)
     teps = sum(p.num_edges * p.iterations for p in res.phases) / max(

@@ -472,13 +472,15 @@ class PhaseRunner:
 
 
 def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
-               max_phases, verbose):
+               max_phases, verbose, tracer):
     """Single-shard fused execution: one device call for the whole
-    clustering (cuvite_tpu/louvain/fused.py), one host sync at the end."""
+    clustering (cuvite_tpu/louvain/fused.py), one host sync at the end.
+    ``tracer`` is always supplied by louvain_phases (NullTracer default)."""
     from cuvite_tpu.louvain.fused import fused_louvain
 
     t_start = time.perf_counter()
-    dg = DistGraph.build(graph, 1, balanced=balanced)
+    with tracer.stage("plan"):
+        dg = DistGraph.build(graph, 1, balanced=balanced)
     sh = dg.shards[0]
     nv_pad = dg.nv_pad
     wdt = _device_dtype(graph.policy.weight_dtype)
@@ -491,21 +493,23 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
         ths = np.full(max_p, threshold, dtype=wdt)
     constant = jnp.asarray(1.0 / graph.total_edge_weight_twice(), dtype=wdt)
 
-    out = fused_louvain(
-        jnp.asarray(np.asarray(sh.src).astype(np.int32)),
-        jnp.asarray(np.asarray(sh.dst).astype(np.int32)),
-        jnp.asarray(np.asarray(sh.w).astype(wdt)),
-        jnp.asarray(ths),
-        constant,
-        jnp.asarray(dg.vertex_mask()),
-        nv_pad=nv_pad,
-        max_phases=max_p,
-        accum_dtype=adt,
-        cycling=bool(threshold_cycling and not one_phase),
-    )
-    (labels, prev_mod, n_phases, tot_iters, mod_hist, iter_hist,
-     nc_hist) = jax.device_get(out)
+    with tracer.stage("iterate"):
+        out = fused_louvain(
+            jnp.asarray(np.asarray(sh.src).astype(np.int32)),
+            jnp.asarray(np.asarray(sh.dst).astype(np.int32)),
+            jnp.asarray(np.asarray(sh.w).astype(wdt)),
+            jnp.asarray(ths),
+            constant,
+            jnp.asarray(dg.vertex_mask()),
+            nv_pad=nv_pad,
+            max_phases=max_p,
+            accum_dtype=adt,
+            cycling=bool(threshold_cycling and not one_phase),
+        )
+        (labels, prev_mod, n_phases, tot_iters, mod_hist, iter_hist,
+         nc_hist) = jax.device_get(out)
     total_s = time.perf_counter() - t_start
+    tracer.count("traversed_edges", graph.num_edges * int(tot_iters))
 
     n_phases = int(n_phases)
     tot_iters = int(tot_iters)
@@ -551,6 +555,7 @@ def louvain_phases(
     vertex_ordering: int = 0,
     max_phases: int = TERMINATION_PHASE_COUNT,
     verbose: bool = False,
+    tracer=None,
 ) -> LouvainResult:
     """Full multi-phase Louvain (the main.cpp:218-495 loop).
 
@@ -584,11 +589,15 @@ def louvain_phases(
             communities=comm_all, modularity=0.0, phases=[],
             total_iterations=0, total_seconds=0.0,
         )
+    if tracer is None:
+        from cuvite_tpu.utils.trace import NullTracer
+
+        tracer = NullTracer()
     if engine == "fused":
         return _run_fused(
             graph, threshold=threshold, threshold_cycling=threshold_cycling,
             one_phase=one_phase, balanced=balanced, max_phases=max_phases,
-            verbose=verbose,
+            verbose=verbose, tracer=tracer,
         )
 
     phases: list[PhaseStats] = []
@@ -604,11 +613,12 @@ def louvain_phases(
         t1 = time.perf_counter()
         # Shape floors: every coarsened phase small enough to fit them reuses
         # one compiled step instead of recompiling per phase.
-        dg = DistGraph.build(
-            g, nshards, balanced=balanced,
-            min_nv_pad=max(1, 4096 // nshards),
-            min_ne_pad=max(1, 16384 // nshards),
-        )
+        with tracer.stage("plan"):
+            dg = DistGraph.build(
+                g, nshards, balanced=balanced,
+                min_nv_pad=max(1, 4096 // nshards),
+                min_ne_pad=max(1, 16384 // nshards),
+            )
         color_dev = None
         n_classes = 0
         if (coloring or vertex_ordering) and phase == 0:
@@ -640,13 +650,16 @@ def louvain_phases(
                 color_dev = (shard_1d(mesh, cpad) if mesh is not None
                              else jnp.asarray(cpad))
 
-        runner = PhaseRunner(dg, mesh=mesh, engine=engine)
-        comm_pad, curr_mod, iters = runner.run(
-            th, lower=-1.0, et_mode=et_mode, et_delta=et_delta,
-            color_classes=color_dev, n_color_classes=n_classes,
-        )
+        with tracer.stage("plan"):
+            runner = PhaseRunner(dg, mesh=mesh, engine=engine)
+        with tracer.stage("iterate"):
+            comm_pad, curr_mod, iters = runner.run(
+                th, lower=-1.0, et_mode=et_mode, et_delta=et_delta,
+                color_classes=color_dev, n_color_classes=n_classes,
+            )
         t2 = time.perf_counter()
         tot_iters += iters
+        tracer.count("traversed_edges", g.num_edges * iters)
 
         # Map padded-space communities back to original-id labels for the
         # real vertices of this phase's graph.
@@ -668,7 +681,8 @@ def louvain_phases(
             if one_phase:
                 prev_mod = curr_mod
                 break
-            g = coarsen_graph(g, dense, nc)
+            with tracer.stage("coarsen"):
+                g = coarsen_graph(g, dense, nc)
             prev_mod = curr_mod
             phase += 1
         else:

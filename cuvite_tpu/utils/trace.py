@@ -1,0 +1,95 @@
+"""Tracing / profiling / diagnostics.
+
+The reference instruments every stage with MPI_Wtime pairs printed per
+rank (/root/reference/main.cpp:241-258, :353-358, :411-426, the
+DEBUG_PRINTF stage breakdowns in louvain.cpp:472-538, and the
+PRINT_TIMEDS GPU timers, louvain_cuda.cu:2456-2461), tracks the memory
+high-water with getrusage (main.cpp:142-150), and routes diagnostics to
+per-rank `dat.out.<rank>` files (main.cpp:101-110).
+
+Here that collapses into one Tracer object: named accumulating stage
+timers (wall clock; device work is timed around blocking host syncs, the
+only boundaries that exist under jit), RSS high-water, TEPS accounting
+(main.cpp:448, :509), and optional per-shard diag files.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import resource
+import time
+
+
+def rss_high_water_mb() -> float:
+    """Peak resident set size of this process in MiB (the reference prints
+    getrusage ru_maxrss the same way, main.cpp:142-150)."""
+    ru = resource.getrusage(resource.RUSAGE_SELF)
+    # ru_maxrss is KiB on Linux.
+    return ru.ru_maxrss / 1024.0
+
+
+class Tracer:
+    """Accumulating named stage timers + counters.
+
+    Usage::
+
+        tr = Tracer()
+        with tr.stage("load"):
+            ...
+        tr.count("iterations", n)
+        print(tr.report())
+    """
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.times: dict[str, float] = {}
+        self.calls: dict[str, int] = {}
+        self.counters: dict[str, float] = {}
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.times[name] = self.times.get(name, 0.0) + dt
+            self.calls[name] = self.calls.get(name, 0) + 1
+
+    def count(self, name: str, value: float = 1) -> None:
+        if self.enabled:
+            self.counters[name] = self.counters.get(name, 0) + value
+
+    def teps(self) -> float:
+        """Traversed edges per second: counter 'traversed_edges' over the
+        'iterate' stage WALL time.  Unlike the steady-state bench metric
+        (bench.py warm-up excludes compilation, cf. main.cpp:499-518),
+        this includes any one-time XLA compile that ran inside the stage —
+        the report labels it accordingly."""
+        t = self.times.get("iterate", 0.0)
+        return self.counters.get("traversed_edges", 0.0) / t if t else 0.0
+
+    def report(self) -> str:
+        lines = ["stage breakdown (s):"]
+        total = sum(self.times.values())
+        for name, t in sorted(self.times.items(), key=lambda kv: -kv[1]):
+            lines.append(
+                f"  {name:<16} {t:9.3f}  ({self.calls[name]}x, "
+                f"{100.0 * t / total if total else 0.0:4.1f}%)"
+            )
+        for name, v in sorted(self.counters.items()):
+            lines.append(f"  {name:<16} {v:g}")
+        if self.counters.get("traversed_edges"):
+            lines.append(
+                f"  TEPS (wall, incl. compile) {self.teps():.4g}"
+            )
+        lines.append(f"  rss high-water   {rss_high_water_mb():.0f} MiB")
+        return "\n".join(lines)
+
+
+class NullTracer(Tracer):
+    def __init__(self):
+        super().__init__(enabled=False)

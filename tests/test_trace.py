@@ -1,0 +1,54 @@
+"""Tracer / diagnostics tests."""
+
+import numpy as np
+
+from cuvite_tpu.louvain.driver import louvain_phases
+from cuvite_tpu.utils.trace import NullTracer, Tracer, rss_high_water_mb
+
+
+def test_tracer_stages_and_counters():
+    tr = Tracer()
+    with tr.stage("load"):
+        pass
+    with tr.stage("iterate"):
+        pass
+    with tr.stage("iterate"):
+        pass
+    tr.count("traversed_edges", 1000)
+    assert tr.calls["iterate"] == 2
+    assert tr.counters["traversed_edges"] == 1000
+    rep = tr.report()
+    assert "iterate" in rep and "TEPS" in rep and "rss high-water" in rep
+
+
+def test_null_tracer_is_free():
+    tr = NullTracer()
+    with tr.stage("x"):
+        pass
+    tr.count("y")
+    assert tr.times == {} and tr.counters == {}
+
+
+def test_rss_positive():
+    assert rss_high_water_mb() > 1.0
+
+
+def test_driver_fills_tracer(karate):
+    for engine in ("bucketed", "fused"):
+        tr = Tracer()
+        res = louvain_phases(karate, engine=engine, tracer=tr)
+        assert res.modularity > 0.40
+        assert tr.times.get("iterate", 0) > 0
+        assert tr.counters["traversed_edges"] >= karate.num_edges
+        assert tr.teps() > 0
+
+
+def test_cli_trace_flag(tmp_path, karate, capsys):
+    from cuvite_tpu.cli import main
+    from cuvite_tpu.io.vite import write_vite
+
+    p = str(tmp_path / "k.bin")
+    write_vite(p, karate)
+    main(["--file", p, "--bits64", "--trace", "--quiet"])
+    out = capsys.readouterr().out
+    assert "stage breakdown" in out and "TEPS" in out
