@@ -75,6 +75,12 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--engine", default="auto",
                      choices=["auto", "sort", "bucketed", "pallas", "fused"],
                      help="execution engine (auto = degree-bucketed)")
+    run.add_argument("--checkpoint-dir", metavar="DIR",
+                     help="save inter-phase state after each phase "
+                          "(the reference has no mid-run persistence)")
+    run.add_argument("--resume", action="store_true",
+                     help="resume from the latest checkpoint in "
+                          "--checkpoint-dir")
 
     out = p.add_argument_group("output")
     out.add_argument("--output", "-o", action="store_true",
@@ -105,6 +111,10 @@ def validate(args) -> None:
         raise SystemExit("Cannot combine --one-phase with --threshold-cycling")
     if args.early_term in (2, 4) and not (0.0 <= args.et_delta <= 1.0):
         raise SystemExit("--et-delta must be in [0, 1]")
+    if args.resume and not args.checkpoint_dir:
+        raise SystemExit("--resume requires --checkpoint-dir")
+    if args.checkpoint_dir and args.one_phase:
+        raise SystemExit("--checkpoint-dir is incompatible with --one-phase")
 
 
 def main(argv=None) -> int:
@@ -162,6 +172,8 @@ def main(argv=None) -> int:
         vertex_ordering=args.vertex_ordering or 0,
         verbose=not args.quiet,
         tracer=tracer,
+        checkpoint_dir=args.checkpoint_dir,
+        resume=args.resume,
     )
     if args.trace:
         print(tracer.report())

@@ -556,6 +556,8 @@ def louvain_phases(
     max_phases: int = TERMINATION_PHASE_COUNT,
     verbose: bool = False,
     tracer=None,
+    checkpoint_dir: str | None = None,
+    resume: bool = False,
 ) -> LouvainResult:
     """Full multi-phase Louvain (the main.cpp:218-495 loop).
 
@@ -575,10 +577,11 @@ def louvain_phases(
         engine = "bucketed"
     if engine == "fused" and (
         et_mode or coloring or vertex_ordering or mesh is not None
-        or nshards > 1
+        or nshards > 1 or checkpoint_dir is not None
     ):
         # The fused program covers the default single-shard schedule; the
-        # per-phase drivers own the ET/coloring variants and SPMD.
+        # per-phase drivers own the ET/coloring variants, SPMD, and
+        # checkpointing (which needs phase boundaries on the host).
         engine = "bucketed"
 
     nv0 = graph.num_vertices
@@ -600,6 +603,13 @@ def louvain_phases(
             verbose=verbose, tracer=tracer,
         )
 
+    if checkpoint_dir and one_phase:
+        raise ValueError(
+            "checkpoint_dir is incompatible with one_phase: the run ends "
+            "after its single phase, so there is no state to resume "
+            "(use max_phases=1 to bound a checkpointed run instead)"
+        )
+
     phases: list[PhaseStats] = []
     prev_mod = -1.0
     tot_iters = 0
@@ -607,7 +617,33 @@ def louvain_phases(
     phase = 0
     g = graph
 
+    if resume and checkpoint_dir:
+        from cuvite_tpu.utils.checkpoint import load_latest
+
+        ck = load_latest(checkpoint_dir)
+        if ck is not None and len(ck.comm_all) == nv0 \
+                and ck.orig_ne == graph.num_edges:
+            g = ck.graph
+            comm_all = ck.comm_all
+            prev_mod = ck.prev_mod
+            phase = ck.phase
+            tot_iters = ck.tot_iters
+            phases = [
+                PhaseStats(phase=p, modularity=float(ck.mod_hist[p]),
+                           iterations=int(ck.iter_hist[p]),
+                           num_vertices=int(ck.nv_hist[p]),
+                           num_edges=int(ck.ne_hist[p]), seconds=0.0)
+                for p in range(ck.phase)
+            ]
+            if verbose:
+                print(f"Resumed from {checkpoint_dir} at phase {phase} "
+                      f"(Q={prev_mod:.6f})")
+
     while True:
+        # Top-of-loop guard so a resumed run whose checkpoint already hit
+        # max_phases (or the iteration cap) does not execute an extra phase.
+        if phase >= max_phases or tot_iters > MAX_TOTAL_ITERATIONS:
+            break
         th = threshold_for_phase(phase) if (threshold_cycling and not one_phase) \
             else threshold
         t1 = time.perf_counter()
@@ -685,6 +721,20 @@ def louvain_phases(
                 g = coarsen_graph(g, dense, nc)
             prev_mod = curr_mod
             phase += 1
+            if checkpoint_dir:
+                from cuvite_tpu.utils.checkpoint import (
+                    PhaseCheckpoint, save_phase,
+                )
+
+                save_phase(checkpoint_dir, PhaseCheckpoint(
+                    phase=phase, comm_all=comm_all, graph=g,
+                    prev_mod=prev_mod, tot_iters=tot_iters,
+                    mod_hist=np.array([p.modularity for p in phases]),
+                    iter_hist=np.array([p.iterations for p in phases]),
+                    nv_hist=np.array([p.num_vertices for p in phases]),
+                    ne_hist=np.array([p.num_edges for p in phases]),
+                    orig_ne=graph.num_edges,
+                ))
         else:
             # Safety net: when cycling exits early, run one final 1e-6 pass
             # (main.cpp:432-442).  Note: lower must be -1 (not prev_mod), or
@@ -704,9 +754,6 @@ def louvain_phases(
                         num_vertices=g.num_vertices, num_edges=g.num_edges,
                         seconds=time.perf_counter() - t1,
                     ))
-            break
-
-        if phase >= max_phases or tot_iters > MAX_TOTAL_ITERATIONS:
             break
 
     # Final contiguous renumber of the composed labels (main.cpp:374-394).

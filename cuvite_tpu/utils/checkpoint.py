@@ -1,0 +1,119 @@
+"""Phase-granular checkpoint / resume for the multi-phase driver.
+
+The reference has NO mid-run persistence — a failed 200-phase run on a
+billion-edge graph starts over ("resume = re-run"; its only outputs are
+the final .communities file, main.cpp:521-550, and generator write-out).
+This framework checkpoints the inter-phase state, which is tiny compared
+to the input graph: the composed per-vertex labels, the current coarse
+graph, and the driver counters.  Each phase's file is self-contained and
+atomic (write-to-temp + rename), so a run killed at any point resumes
+from the last completed phase.
+
+Format: one `phase_NNNN.npz` per completed phase in the checkpoint
+directory; the highest-numbered complete file wins.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import zipfile
+
+import numpy as np
+
+from cuvite_tpu.core.graph import Graph
+from cuvite_tpu.core.types import Policy
+
+
+@dataclasses.dataclass
+class PhaseCheckpoint:
+    phase: int               # next phase index to run
+    comm_all: np.ndarray     # composed labels for the ORIGINAL vertices
+    graph: Graph             # current coarse graph
+    prev_mod: float
+    tot_iters: int
+    mod_hist: np.ndarray     # per completed phase
+    iter_hist: np.ndarray
+    nv_hist: np.ndarray      # vertices/edges of each completed phase's graph
+    ne_hist: np.ndarray
+    orig_ne: int = -1        # edge count of the ORIGINAL graph (fingerprint)
+
+
+def _path(ckpt_dir: str, phase: int) -> str:
+    return os.path.join(ckpt_dir, f"phase_{phase:04d}.npz")
+
+
+def save_phase(ckpt_dir: str, ck: PhaseCheckpoint) -> str:
+    os.makedirs(ckpt_dir, exist_ok=True)
+    path = _path(ckpt_dir, ck.phase)
+    tmp = path + ".tmp"
+    g = ck.graph
+    with open(tmp, "wb") as f:
+        np.savez(
+            f,
+            phase=np.int64(ck.phase),
+            comm_all=ck.comm_all,
+            offsets=g.offsets,
+            tails=g.tails,
+            weights=g.weights,
+            vertex_dtype=np.str_(np.dtype(g.policy.vertex_dtype).name),
+            weight_dtype=np.str_(np.dtype(g.policy.weight_dtype).name),
+            accum_dtype=np.str_(np.dtype(g.policy.accum_dtype).name),
+            prev_mod=np.float64(ck.prev_mod),
+            tot_iters=np.int64(ck.tot_iters),
+            mod_hist=np.asarray(ck.mod_hist, dtype=np.float64),
+            iter_hist=np.asarray(ck.iter_hist, dtype=np.int64),
+            nv_hist=np.asarray(ck.nv_hist, dtype=np.int64),
+            ne_hist=np.asarray(ck.ne_hist, dtype=np.int64),
+            orig_ne=np.int64(ck.orig_ne),
+        )
+    os.replace(tmp, path)
+    # Runs advance monotonically, so any higher-numbered file is leftover
+    # state from a PREVIOUS run in the same directory; clear it or a later
+    # --resume would pick the stale run's final phase over this one.
+    for name in os.listdir(ckpt_dir):
+        if name.startswith("phase_") and name.endswith(".npz"):
+            try:
+                num = int(name[6:10])
+            except ValueError:
+                continue
+            if num > ck.phase:
+                os.remove(os.path.join(ckpt_dir, name))
+    return path
+
+
+def load_latest(ckpt_dir: str) -> PhaseCheckpoint | None:
+    if not os.path.isdir(ckpt_dir):
+        return None
+    names = sorted(
+        n for n in os.listdir(ckpt_dir)
+        if n.startswith("phase_") and n.endswith(".npz")
+    )
+    for name in reversed(names):
+        path = os.path.join(ckpt_dir, name)
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                policy = Policy(
+                    vertex_dtype=np.dtype(str(z["vertex_dtype"])),
+                    weight_dtype=np.dtype(str(z["weight_dtype"])),
+                    accum_dtype=np.dtype(str(z["accum_dtype"])),
+                )
+                graph = Graph(
+                    offsets=z["offsets"], tails=z["tails"],
+                    weights=z["weights"], policy=policy,
+                )
+                return PhaseCheckpoint(
+                    phase=int(z["phase"]),
+                    comm_all=np.asarray(z["comm_all"]),
+                    graph=graph,
+                    prev_mod=float(z["prev_mod"]),
+                    tot_iters=int(z["tot_iters"]),
+                    mod_hist=np.asarray(z["mod_hist"]),
+                    iter_hist=np.asarray(z["iter_hist"]),
+                    nv_hist=np.asarray(z["nv_hist"]),
+                    ne_hist=np.asarray(z["ne_hist"]),
+                    orig_ne=int(z["orig_ne"]),
+                )
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+            continue  # truncated/corrupt file: fall back to the previous one
+    return None

@@ -1,0 +1,81 @@
+"""Checkpoint/resume: a run interrupted at any phase boundary resumes to
+the same final clustering as an uninterrupted run."""
+
+import numpy as np
+
+from cuvite_tpu.louvain.driver import louvain_phases
+from cuvite_tpu.utils.checkpoint import load_latest
+
+
+def test_resume_matches_uninterrupted(karate, tmp_path):
+    ckpt = str(tmp_path / "ck")
+    full = louvain_phases(karate)
+
+    # "Crash" after phase 0: limit to one phase but write checkpoints.
+    part = louvain_phases(karate, checkpoint_dir=ckpt, max_phases=1)
+    ck = load_latest(ckpt)
+    assert ck is not None and ck.phase == 1
+    assert part.modularity < full.modularity  # genuinely interrupted
+
+    res = louvain_phases(karate, checkpoint_dir=ckpt, resume=True)
+    assert res.modularity == full.modularity
+    assert np.array_equal(res.communities, full.communities)
+    assert res.total_iterations == full.total_iterations
+    assert [p.modularity for p in res.phases] == \
+        [p.modularity for p in full.phases]
+
+
+def test_resume_without_checkpoint_is_fresh(karate, tmp_path):
+    ckpt = str(tmp_path / "empty")
+    res = louvain_phases(karate, checkpoint_dir=ckpt, resume=True)
+    full = louvain_phases(karate)
+    assert np.array_equal(res.communities, full.communities)
+
+
+def test_checkpoint_mismatched_graph_ignored(karate, ring8, tmp_path):
+    """A checkpoint for a different graph (vertex-count mismatch) must not
+    be loaded."""
+    ckpt = str(tmp_path / "ck")
+    louvain_phases(karate, checkpoint_dir=ckpt, max_phases=1)
+    res = louvain_phases(ring8, checkpoint_dir=str(tmp_path / "ck"),
+                         resume=True)
+    fresh = louvain_phases(ring8)
+    assert np.array_equal(res.communities, fresh.communities)
+
+
+def test_corrupt_checkpoint_falls_back(karate, tmp_path):
+    ckpt = tmp_path / "ck"
+    louvain_phases(karate, checkpoint_dir=str(ckpt), max_phases=1)
+    # Corrupt a later (higher-numbered) file; loader must skip it.
+    bad = ckpt / "phase_0099.npz"
+    bad.write_bytes(b"not a zip")
+    ck = load_latest(str(ckpt))
+    assert ck is not None and ck.phase == 1
+
+
+def test_resume_at_max_phases_runs_nothing_more(karate, tmp_path):
+    ckpt = str(tmp_path / "ck")
+    part = louvain_phases(karate, checkpoint_dir=ckpt, max_phases=1)
+    res = louvain_phases(karate, checkpoint_dir=ckpt, resume=True,
+                         max_phases=1)
+    assert len(res.phases) == 1
+    assert res.modularity == part.modularity
+
+
+def test_stale_higher_checkpoints_cleared(karate, tmp_path):
+    """A fresh (non-resume) run in a reused directory must not leave a
+    previous run's later phases to hijack a subsequent --resume."""
+    ckpt = str(tmp_path / "ck")
+    full = louvain_phases(karate, checkpoint_dir=ckpt)      # run A: N phases
+    louvain_phases(karate, checkpoint_dir=ckpt, max_phases=1)  # run B killed
+    ck = load_latest(ckpt)
+    assert ck is not None and ck.phase == 1                 # run A's cleared
+    res = louvain_phases(karate, checkpoint_dir=ckpt, resume=True)
+    assert res.modularity == full.modularity
+
+
+def test_one_phase_with_checkpoint_rejected(karate, tmp_path):
+    import pytest
+
+    with pytest.raises(ValueError):
+        louvain_phases(karate, checkpoint_dir=str(tmp_path), one_phase=True)
