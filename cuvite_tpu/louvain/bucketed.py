@@ -168,19 +168,39 @@ class StackedPlan:
     self_loop: np.ndarray    # [S*nv_pad]
 
 
-def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS) -> StackedPlan:
+def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
+                        exchange_plan=None) -> StackedPlan:
     """Build one BucketPlan per shard of ``dg`` and pad them to common
     shapes.  A width class appears iff some shard has vertices in it; shards
-    without rows in a kept class contribute all-padding rows."""
+    without rows in a kept class contribute all-padding rows.
+
+    With ``exchange_plan`` (a comm.exchange.ExchangePlan) dst ids are
+    remapped into each shard's extended-local space [0, nv_pad + ghost_pad)
+    — the layout the sparse-exchange step gathers from — and self-loop
+    detection switches to the local formulation (base=0: remapped self edge
+    has dst == src local index)."""
     nshards = dg.nshards
     nvl = dg.nv_pad
-    plans = [
-        BucketPlan.build(
-            np.asarray(sh.src), np.asarray(sh.dst), np.asarray(sh.w),
-            nv_local=nvl, base=s * nvl, widths=widths,
-        )
-        for s, sh in enumerate(dg.shards)
-    ]
+    if exchange_plan is not None:
+        plans = [
+            BucketPlan.build(
+                np.asarray(sh.src),
+                exchange_plan.remap_dst(
+                    s, np.asarray(sh.src), np.asarray(sh.dst)
+                ).astype(np.asarray(sh.dst).dtype),
+                np.asarray(sh.w),
+                nv_local=nvl, base=0, widths=widths,
+            )
+            for s, sh in enumerate(dg.shards)
+        ]
+    else:
+        plans = [
+            BucketPlan.build(
+                np.asarray(sh.src), np.asarray(sh.dst), np.asarray(sh.w),
+                nv_local=nvl, base=s * nvl, widths=widths,
+            )
+            for s, sh in enumerate(dg.shards)
+        ]
     by_width = [{b.width: b for b in p.buckets} for p in plans]
     stacked_buckets = []
     for width in widths:
@@ -221,13 +241,16 @@ class RowResult(NamedTuple):
     best_c: jax.Array    # [Nb] best candidate community (sentinel if none)
     best_gain: jax.Array  # [Nb]
     counter0: jax.Array  # [Nb] weight to current community (incl self-loops)
+    best_size: jax.Array | None  # [Nb] size of best community (sparse mode)
 
 
-def _row_argmax(cmat, wmat, curr_comm, vdeg_v, eix_v, comm_deg, constant,
-                sentinel):
+def _row_argmax(cmat, wmat, aymat, smat, curr_comm, vdeg_v, eix_v, ax_v,
+                constant, sentinel):
     """Dedup + dQ + argmax for one chunk of bucket rows.
 
-    cmat [T, D] neighbor communities; wmat [T, D] weights; the rest [T].
+    cmat [T, D] neighbor communities; wmat [T, D] weights; aymat [T, D] the
+    candidate community's degree a_y per slot; smat [T, D] (or None) the
+    candidate community's size per slot; ax_v [T] = a_x = deg(curr) - k_i.
     Replicates distGetMaxIndex (/root/reference/louvain.cpp:2185-2244):
     gain = 2*(e_iy - e_ix) - 2*k_i*(a_y - a_x)/2m, ties to smaller id.
     """
@@ -247,10 +270,8 @@ def _row_argmax(cmat, wmat, curr_comm, vdeg_v, eix_v, comm_deg, constant,
     # them out of the candidate set.
     valid = (~dup) & (~is_cc)
 
-    a_y = jnp.take(comm_deg, cmat)
-    a_x = (jnp.take(comm_deg, curr_comm) - vdeg_v)[:, None]
     gain = 2.0 * (wagg - eix_v[:, None]) \
-        - 2.0 * vdeg_v[:, None] * (a_y - a_x) * constant
+        - 2.0 * vdeg_v[:, None] * (aymat - ax_v[:, None]) * constant
     neg_inf = jnp.array(-jnp.inf, dtype=wdt)
     gain = jnp.where(valid, gain, neg_inf)
     best_gain = jnp.max(gain, axis=1)
@@ -258,11 +279,20 @@ def _row_argmax(cmat, wmat, curr_comm, vdeg_v, eix_v, comm_deg, constant,
     best_c = jnp.min(
         jnp.where(at_best, cmat, jnp.full_like(cmat, sentinel)), axis=1
     )
-    return RowResult(best_c=best_c, best_gain=best_gain, counter0=counter0)
+    best_size = None
+    if smat is not None:
+        # size of the winning community: any slot with that community id
+        # carries the same attached size.
+        chosen = cmat == best_c[:, None]
+        best_size = jnp.min(
+            jnp.where(chosen, smat, jnp.full_like(smat, sentinel)), axis=1
+        )
+    return RowResult(best_c=best_c, best_gain=best_gain, counter0=counter0,
+                     best_size=best_size)
 
 
-def _row_argmax_sorted(cmat, wmat, curr_comm, vdeg_v, eix_v, comm_deg,
-                       constant, sentinel):
+def _row_argmax_sorted(cmat, wmat, aymat, smat, curr_comm, vdeg_v, eix_v,
+                       ax_v, constant, sentinel):
     """Dedup + dQ + argmax for wide rows via a per-row sort.
 
     O(D log^2 D) per row instead of the all-pairs O(D^2): sort each row by
@@ -273,7 +303,12 @@ def _row_argmax_sorted(cmat, wmat, curr_comm, vdeg_v, eix_v, comm_deg,
     """
     wdt = wmat.dtype
     D = cmat.shape[1]
-    c_s, w_s = jax.lax.sort((cmat, wmat), dimension=1, num_keys=1)
+    if smat is not None:
+        c_s, w_s, ay_s, s_s = jax.lax.sort(
+            (cmat, wmat, aymat, smat), dimension=1, num_keys=1)
+    else:
+        c_s, w_s, ay_s = jax.lax.sort(
+            (cmat, wmat, aymat), dimension=1, num_keys=1)
     leader = jnp.concatenate(
         [jnp.ones_like(c_s[:, :1], dtype=bool), c_s[:, 1:] != c_s[:, :-1]],
         axis=1,
@@ -295,10 +330,8 @@ def _row_argmax_sorted(cmat, wmat, curr_comm, vdeg_v, eix_v, comm_deg,
     # No w>0 filter — see _row_argmax; padding self-slots are is_cc-masked.
     valid = leader & (~is_cc)
 
-    a_y = jnp.take(comm_deg, c_s)
-    a_x = (jnp.take(comm_deg, curr_comm) - vdeg_v)[:, None]
     gain = 2.0 * (run_sum - eix_v[:, None]) \
-        - 2.0 * vdeg_v[:, None] * (a_y - a_x) * constant
+        - 2.0 * vdeg_v[:, None] * (ay_s - ax_v[:, None]) * constant
     neg_inf = jnp.array(-jnp.inf, dtype=wdt)
     gain = jnp.where(valid, gain, neg_inf)
     best_gain = jnp.max(gain, axis=1)
@@ -306,52 +339,70 @@ def _row_argmax_sorted(cmat, wmat, curr_comm, vdeg_v, eix_v, comm_deg,
     best_c = jnp.min(
         jnp.where(at_best, c_s, jnp.full_like(c_s, sentinel)), axis=1
     )
-    return RowResult(best_c=best_c, best_gain=best_gain, counter0=counter0)
+    best_size = None
+    if smat is not None:
+        chosen = c_s == best_c[:, None]
+        best_size = jnp.min(
+            jnp.where(chosen, s_s, jnp.full_like(s_s, sentinel)), axis=1
+        )
+    return RowResult(best_c=best_c, best_gain=best_gain, counter0=counter0,
+                     best_size=best_size)
 
 
-def _rows_chunked(cmat, w_mat, curr, vdeg_v, eix_v, comm_deg, constant,
-                  sentinel):
+def _rows_chunked(cmat, w_mat, dst_mat, curr, vdeg_v, eix_v, ax_v,
+                  constant, sentinel, gather_ay, gather_sz):
     """Dispatch rows to the right dedup variant, chunked with lax.map to
-    bound intermediate memory."""
+    bound intermediate memory.  ``gather_ay``/``gather_sz`` produce the
+    per-slot community degree / size matrices from (dst_chunk, cmat_chunk)
+    INSIDE each chunk, so the transient [chunk, D] gathers never materialize
+    at full bucket size (``gather_sz`` may return None in replicated mode)."""
     nb, width = cmat.shape
     kernel = (_row_argmax if width <= QUADRATIC_MAX_WIDTH
               else _row_argmax_sorted)
     chunk = chunk_for_width(width)
+
+    def run(cm, wm, dm, cu, vd, ei, ax):
+        return kernel(cm, wm, gather_ay(dm, cm), gather_sz(dm, cm),
+                      cu, vd, ei, ax, constant, sentinel)
+
     if nb <= chunk or nb % chunk != 0:
-        return kernel(cmat, w_mat, curr, vdeg_v, eix_v, comm_deg,
-                      constant, sentinel)
+        return run(cmat, w_mat, dst_mat, curr, vdeg_v, eix_v, ax_v)
     nchunk = nb // chunk
 
-    def f(args):
-        return kernel(*args, comm_deg, constant, sentinel)
-
     res = jax.lax.map(
-        f,
+        lambda args: run(*args),
         (
             cmat.reshape(nchunk, chunk, -1),
             w_mat.reshape(nchunk, chunk, -1),
+            dst_mat.reshape(nchunk, chunk, -1),
             curr.reshape(nchunk, chunk),
             vdeg_v.reshape(nchunk, chunk),
             eix_v.reshape(nchunk, chunk),
+            ax_v.reshape(nchunk, chunk),
         ),
     )
     return RowResult(
         best_c=res.best_c.reshape(nb),
         best_gain=res.best_gain.reshape(nb),
         counter0=res.counter0.reshape(nb),
+        best_size=(None if res.best_size is None
+                   else res.best_size.reshape(nb)),
     )
 
 
 def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
                   constant, *, nv_total, sentinel, accum_dtype=None,
-                  axis_name=None, pallas_flags=(), pallas_interpret=False):
+                  axis_name=None, pallas_flags=(), pallas_interpret=False,
+                  sparse_plan=None, nshards=1, budget=0):
     """Full Louvain sweep over one shard using the bucketed engine.
 
     ``bucket_arrays`` is a tuple of (verts, dst_mat, w_mat) triples (one per
     degree class); ``heavy_arrays`` is (src, dst, w) for the residual
     heavy-vertex edges (may be empty-padded).  Returns (target, modularity,
-    n_moved) with semantics identical to louvain_step_local — the two
-    engines are interchangeable and tested for equal outputs.
+    n_moved, overflow) with step semantics identical to louvain_step_local —
+    the two engines are interchangeable and tested for equal outputs.
+    ``overflow`` is the sparse-exchange budget flag (constant False under
+    the replicated exchange).
 
     ``pallas_flags`` (one bool per bucket) routes flagged degree classes
     through the Pallas row-argmax kernel (cuvite_tpu/kernels/row_argmax.py);
@@ -359,21 +410,61 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
     a multiple of 128 (the runner's ``engine='pallas'`` upload does this).
 
     With ``axis_name`` the function runs SPMD inside shard_map: ``comm`` /
-    ``vdeg`` / ``self_loop`` are this shard's slices, ``dst`` ids are global
-    (padded space), and the cross-shard community pull — the analog of
-    fillRemoteCommunities (/root/reference/louvain.cpp:2588-2959) — is an
-    all_gather of the community vector; scalar reductions ride psum.
+    ``vdeg`` / ``self_loop`` are this shard's slices.  Two exchange modes
+    implement the cross-shard community pull (the analog of
+    fillRemoteCommunities, /root/reference/louvain.cpp:2588-2959):
+
+    - replicated (``sparse_plan=None``): dst ids are global (padded space);
+      an all_gather replicates the community vector and full-width
+      psum-reduced comm_deg/comm_size tables — O(nv_total) per chip.
+    - sparse (``sparse_plan=(send_idx, ghost_sel)``): dst ids are
+      extended-local (owned + ghost table); community values and attached
+      community degree/size ride the phase-static ghost routing, community
+      info is sharded by owner and resolved through the budgeted
+      owner-reduce (cuvite_tpu/comm/exchange.py) — O(owned + ghosts).
     """
     nv_local = comm.shape[0]
     wdt = vdeg.dtype
     vdt = comm.dtype
 
-    comm_full, gsum = seg.spmd_env(comm, axis_name)
+    use_sparse = sparse_plan is not None
+    if use_sparse:
+        from cuvite_tpu.comm.exchange import sparse_env, sparse_modularity
 
-    comm_deg = gsum(seg.segment_sum(vdeg, comm, num_segments=nv_total))
-    comm_size = gsum(seg.segment_sum(
-        jnp.ones((nv_local,), dtype=vdt), comm, num_segments=nv_total
-    ))
+        assert axis_name is not None, "sparse exchange requires a mesh axis"
+        assert not any(pallas_flags or ()), \
+            "pallas buckets are single-shard only"
+        env = sparse_env(comm, vdeg, sparse_plan[0], sparse_plan[1],
+                         axis_name, nshards=nshards, budget=budget)
+        comm_ref = env.comm_ext      # gather table for dst indices
+
+        def gsum(x):
+            return jax.lax.psum(x, axis_name)
+
+        overflow = jax.lax.psum(env.overflow.astype(jnp.int32),
+                                axis_name) > 0
+    else:
+        env = None
+        comm_ref, gsum = seg.spmd_env(comm, axis_name)
+        comm_deg = gsum(seg.segment_sum(vdeg, comm, num_segments=nv_total))
+        comm_size = gsum(seg.segment_sum(
+            jnp.ones((nv_local,), dtype=vdt), comm, num_segments=nv_total
+        ))
+        overflow = jnp.zeros((), dtype=bool)  # replicated: can't overflow
+
+    # Community-info lookups.  Sparse mode reads values ATTACHED to the
+    # referenced vertex (indexed by dst in the extended-local table);
+    # replicated mode looks the community id up in the full tables.
+    def slot_ay(dst_idx, ck):
+        return (jnp.take(env.cdeg_ext, dst_idx) if use_sparse
+                else jnp.take(comm_deg, ck))
+
+    def slot_size(dst_idx, ck):
+        return jnp.take(env.csize_ext, dst_idx) if use_sparse else None
+
+    def own_deg(v_safe):   # comm_deg[comm[v]] for owned v
+        return (jnp.take(env.cdeg_v, v_safe) if use_sparse
+                else jnp.take(comm_deg, jnp.take(comm, v_safe)))
 
     # Per-vertex weight into the current community (incl. self-loops) comes
     # out of the bucket pass; start from zero and accumulate per class.
@@ -381,13 +472,14 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
     best_c = jnp.full((nv_local,), sentinel, dtype=vdt)
     neg_inf = jnp.array(-jnp.inf, dtype=wdt)
     best_gain = jnp.full((nv_local,), neg_inf, dtype=wdt)
+    best_size = jnp.zeros((nv_local,), dtype=vdt) if use_sparse else None
 
     # eix depends on counter0 which the buckets themselves produce; the gain
     # formula needs it per ROW, so compute counter0 first (cheap masked sums)
     # then run the argmax passes.  For bucket rows counter0 is row-local;
     # compute it inline per bucket and assemble.
     hs, hd, hw = heavy_arrays
-    ckey_h = jnp.take(comm_full, hd)
+    ckey_h = jnp.take(comm_ref, hd)
     csrc_h = jnp.take(comm, jnp.minimum(hs, nv_local - 1))
     c0_heavy = seg.segment_sum(
         jnp.where(ckey_h == csrc_h, hw, jnp.zeros_like(hw)), hs,
@@ -409,7 +501,7 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
         if is_pallas[i]:
             from cuvite_tpu.kernels.row_argmax import row_argmax_pallas
 
-            cmat_t = jnp.take(comm_full, dst_mat)   # [D, Nb]
+            cmat_t = jnp.take(comm_ref, dst_mat)   # [D, Nb]
             vdeg_v = jnp.take(vdeg, safe_v)
             bc, bg, c0_rows = row_argmax_pallas(
                 cmat_t, w_mat, jnp.take(comm_deg, cmat_t),
@@ -421,32 +513,41 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
             best_c = best_c.at[verts].set(bc.astype(vdt), mode="drop")
             best_gain = best_gain.at[verts].set(bg, mode="drop")
             continue
-        cmat = jnp.take(comm_full, dst_mat)
+        cmat = jnp.take(comm_ref, dst_mat)
         c0_rows = jnp.sum(
             jnp.where(cmat == curr[:, None], w_mat, 0.0), axis=1
         ).astype(wdt)
         counter0 = counter0.at[verts].add(c0_rows, mode="drop")
-        row_results.append((verts, cmat, w_mat, curr))
+        row_results.append((verts, dst_mat, cmat, w_mat, curr))
     eix = counter0 - self_loop
 
-    for verts, cmat, w_mat, curr in row_results:
+    for verts, dst_mat, cmat, w_mat, curr in row_results:
         safe_v = jnp.minimum(verts, nv_local - 1)
-        res = _rows_chunked(cmat, w_mat, curr,
-                            jnp.take(vdeg, safe_v), jnp.take(eix, safe_v),
-                            comm_deg, constant, sentinel)
+        vdeg_v = jnp.take(vdeg, safe_v)
+        res = _rows_chunked(cmat, w_mat, dst_mat,
+                            curr, vdeg_v, jnp.take(eix, safe_v),
+                            own_deg(safe_v) - vdeg_v,
+                            constant, sentinel, slot_ay, slot_size)
         best_c = best_c.at[verts].set(res.best_c, mode="drop")
         best_gain = best_gain.at[verts].set(res.best_gain, mode="drop")
+        if use_sparse:
+            best_size = best_size.at[verts].set(res.best_size, mode="drop")
 
     # ---- heavy vertices: sort-based candidates on their edges only -------
-    src_s, ckey_s, w_s = seg.sort_edges_by_vertex_comm(hs, ckey_h, hw)
+    if use_sparse:
+        src_s, ckey_s, w_s, ay_s, ts_s = seg.sort_edges_by_vertex_comm(
+            hs, ckey_h, hw, jnp.take(env.cdeg_ext, hd),
+            jnp.take(env.csize_ext, hd))
+    else:
+        src_s, ckey_s, w_s = seg.sort_edges_by_vertex_comm(hs, ckey_h, hw)
     starts = seg.run_starts(src_s, ckey_s)
     eiy, _ = seg.run_totals(w_s, starts)
     i_s = jnp.minimum(src_s, nv_local - 1)
     comm_i = jnp.take(comm, i_s)
     valid = starts & (src_s < nv_local) & (ckey_s != comm_i)
     k_i = jnp.take(vdeg, i_s)
-    a_y = jnp.take(comm_deg, ckey_s)
-    a_x = jnp.take(comm_deg, comm_i) - k_i
+    a_y = ay_s if use_sparse else jnp.take(comm_deg, ckey_s)
+    a_x = own_deg(i_s) - k_i
     gain = 2.0 * (eiy - jnp.take(eix, i_s)) - 2.0 * k_i * (a_y - a_x) * constant
     gain = jnp.where(valid, gain, neg_inf)
     hg = seg.segment_max(gain, src_s, num_segments=nv_local, sorted_ids=True)
@@ -456,45 +557,74 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
     heavy_better = hg > best_gain
     best_gain = jnp.where(heavy_better, hg, best_gain)
     best_c = jnp.where(heavy_better, hc, best_c)
+    if use_sparse:
+        chosen = at_best & (ckey_s == jnp.take(hc, i_s))
+        ts_cand = jnp.where(chosen, ts_s, jnp.full_like(ts_s, sentinel))
+        h_tsize = seg.segment_min(ts_cand, src_s, num_segments=nv_local,
+                                  sorted_ids=True)
+        best_size = jnp.where(heavy_better, h_tsize, best_size)
 
     # ---- select + singleton guard (louvain.cpp:2230-2241) ----------------
     move = best_gain > 0.0
     best_c_safe = jnp.minimum(best_c, jnp.array(nv_total - 1, dtype=vdt))
-    t_size = jnp.take(comm_size, best_c_safe)
-    c_size = jnp.take(comm_size, comm)
+    if use_sparse:
+        t_size = best_size               # propagated from the winning slot
+        c_size = env.csize_v
+    else:
+        t_size = jnp.take(comm_size, best_c_safe)
+        c_size = jnp.take(comm_size, comm)
     guard = (t_size == 1) & (c_size == 1) & (best_c_safe > comm)
     move = move & ~guard
     target = jnp.where(move, best_c_safe, comm)
 
-    modularity = seg.modularity_terms(counter0, comm_deg, constant, gsum,
-                                      accum_dtype)
+    if use_sparse:
+        modularity = sparse_modularity(counter0, env.deg_local, constant,
+                                       axis_name, accum_dtype)
+    else:
+        modularity = seg.modularity_terms(counter0, comm_deg, constant, gsum,
+                                          accum_dtype)
     n_moved = gsum(jnp.sum(move.astype(jnp.int32)))
-    return target, modularity, n_moved
+    return target, modularity, n_moved, overflow
 
 
 def make_sharded_bucketed_step(mesh, axis_name: str, n_buckets: int,
                                nv_total: int, sentinel: int,
-                               accum_dtype=None):
+                               accum_dtype=None, sparse=None):
     """Jit the bucketed sweep as a shard_map over ``axis_name``: bucket
     matrices, heavy slab and vertex state sharded along axis 0, modularity
-    and move count replicated."""
+    and move count replicated.
+
+    ``sparse``: None for the replicated all_gather exchange, or
+    ``(nshards, budget)`` to run the sparse ghost exchange — the step then
+    takes two trailing plan arrays (send_idx stacked [S*S, B] and ghost_sel
+    stacked [S*G], both sharded along axis 0).  The 4th output is the
+    replicated budget-overflow flag (constant False without sparse)."""
     bspec = tuple((P(axis_name), P(axis_name), P(axis_name))
                   for _ in range(n_buckets))
     hspec = (P(axis_name), P(axis_name), P(axis_name))
+    in_specs = [bspec, hspec, P(axis_name), P(axis_name), P(axis_name), P()]
+    out_specs = (P(axis_name), P(), P(), P())
+    if sparse is not None:
+        nshards, budget = sparse
+        in_specs += [P(axis_name), P(axis_name)]
+    else:
+        nshards, budget = 1, 0
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(bspec, hspec, P(axis_name), P(axis_name), P(axis_name),
-                  P()),
-        out_specs=(P(axis_name), P(), P()),
+        in_specs=tuple(in_specs),
+        out_specs=out_specs,
         check_vma=False,
     )
-    def step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant):
+    def step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant,
+             *plan):
         return bucketed_step(
             bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant,
             nv_total=nv_total, sentinel=sentinel, accum_dtype=accum_dtype,
             axis_name=axis_name,
+            sparse_plan=plan if plan else None,
+            nshards=nshards, budget=budget,
         )
 
     return jax.jit(step)

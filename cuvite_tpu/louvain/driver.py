@@ -150,8 +150,11 @@ def _run_phase_loop(extra, comm0, threshold, lower, *, call, max_iters):
         return ~c[4]
 
     def body(c):
-        past, comm, prev_mod, iters, _ = c
-        target, mod, _ = call(comm, extra)
+        past, comm, prev_mod, iters, _, ovf = c
+        # Uniform step contract: (target, modularity, n_moved, overflow).
+        # The overflow flag (sparse-exchange budget) accumulates so the host
+        # detects an invalid phase with ONE sync at the end.
+        target, mod, _, step_ovf = call(comm, extra)
         mod = mod.astype(wdt)
         iters1 = iters + 1
         no_gain = (mod - prev_mod) < threshold
@@ -159,11 +162,12 @@ def _run_phase_loop(extra, comm0, threshold, lower, *, call, max_iters):
         new_prev = jnp.where(no_gain, prev_mod, jnp.maximum(mod, lower))
         new_past = jnp.where(no_gain, past, comm)
         new_comm = jnp.where(no_gain, comm, target)
-        return (new_past, new_comm, new_prev, iters1, stop)
+        return (new_past, new_comm, new_prev, iters1, stop, ovf | step_ovf)
 
-    init = (comm0, comm0, lower, jnp.int32(0), jnp.bool_(False))
-    past, _, prev_mod, iters, _ = jax.lax.while_loop(cond, body, init)
-    return past, prev_mod, iters
+    init = (comm0, comm0, lower, jnp.int32(0), jnp.bool_(False),
+            jnp.zeros((), dtype=bool))
+    past, _, prev_mod, iters, _, ovf = jax.lax.while_loop(cond, body, init)
+    return past, prev_mod, iters, ovf
 
 
 @functools.lru_cache(maxsize=None)
@@ -183,8 +187,9 @@ def _bucketed_call(nv_total, sentinel, accum_dtype, pallas_flags=(),
 @functools.lru_cache(maxsize=None)
 def _bucketed_sharded_call(step_fn):
     def call(comm, extra):
-        buckets, heavy, self_loop, vdeg, constant = extra
-        return step_fn(buckets, heavy, self_loop, comm, vdeg, constant)
+        buckets, heavy, self_loop, vdeg, constant, *plan = extra
+        return step_fn(buckets, heavy, self_loop, comm, vdeg, constant,
+                       *plan)
 
     return call
 
@@ -211,14 +216,18 @@ class PhaseRunner:
     kernels.  Both run single-shard or SPMD over a mesh.
     """
 
-    def __init__(self, dg: DistGraph, mesh=None, engine: str = "sort"):
+    def __init__(self, dg: DistGraph, mesh=None, engine: str = "sort",
+                 budget: int | None = None, exchange: str = "sparse"):
         if engine not in ("sort", "bucketed", "pallas"):
             raise ValueError(f"unknown engine {engine!r}; use 'sort', "
                              "'bucketed' or 'pallas' ('auto' is resolved "
                              "by louvain_phases)")
+        if exchange not in ("sparse", "replicated"):
+            raise ValueError(f"unknown exchange {exchange!r}")
         self.dg = dg
         self.mesh = mesh
         self.engine = engine
+        self.budget = None
         nv_total = dg.total_padded_vertices
         vdeg = dg.padded_weighted_degrees()
         vdt = _device_dtype(dg.graph.policy.vertex_dtype)
@@ -233,9 +242,36 @@ class PhaseRunner:
             engine = "bucketed"
         if engine == "bucketed" and multi:
             # SPMD bucketed path: per-shard plans padded to common shapes,
-            # sharded along the mesh; comm pull = all_gather inside the step.
+            # sharded along the mesh.  Default exchange is the sparse ghost
+            # plan (comm volume O(owned + ghosts) per iteration); exchange=
+            # 'replicated' keeps the all_gather/psum formulation.
             sentinel = int(np.iinfo(vdt).max)
-            plan = build_stacked_plans(dg)
+            use_sparse = exchange == "sparse"
+            adt_np = np.dtype(adt)
+            S = dg.nshards
+            if use_sparse:
+                from cuvite_tpu.comm.exchange import ExchangePlan
+
+                xplan = ExchangePlan.build(dg)
+                if budget is None:
+                    budget = max(128, dg.nv_pad // 4)
+                budget = min(int(budget), dg.nv_pad)
+                self.budget = budget
+                plan = build_stacked_plans(dg, exchange_plan=xplan)
+                self._send_idx = shard_1d(
+                    mesh, xplan.send_idx.reshape(S * S, xplan.block))
+                self._ghost_sel = shard_1d(
+                    mesh, xplan.ghost_sel.reshape(-1))
+                sparse_cfg = (S, budget)
+                key = ("bucketed-sparse",
+                       tuple(d.id for d in mesh.devices.flat),
+                       len(plan.buckets), nv_total, sentinel, adt_np.name,
+                       budget)
+            else:
+                plan = build_stacked_plans(dg)
+                sparse_cfg = None
+                key = ("bucketed", tuple(d.id for d in mesh.devices.flat),
+                       len(plan.buckets), nv_total, sentinel, adt_np.name)
             buckets = tuple(
                 (shard_1d(mesh, v.astype(vdt)),
                  shard_1d(mesh, d.astype(vdt)),
@@ -247,24 +283,24 @@ class PhaseRunner:
                 for a, t in zip(plan.heavy, (vdt, vdt, wdt))
             )
             self_loop = shard_1d(mesh, plan.self_loop.astype(wdt))
-            adt_np = np.dtype(adt)
-            key = ("bucketed", tuple(d.id for d in mesh.devices.flat),
-                   len(buckets), nv_total, sentinel, adt_np.name)
             step_fn = _STEP_CACHE.get(key)
             if step_fn is None:
                 step_fn = make_sharded_bucketed_step(
                     mesh, VERTEX_AXIS, len(buckets), nv_total, sentinel,
-                    accum_dtype=adt_np,
+                    accum_dtype=adt_np, sparse=sparse_cfg,
                 )
                 _STEP_CACHE[key] = step_fn
 
+            plan_args = ((self._send_idx, self._ghost_sel) if use_sparse
+                         else ())
+
             def _step(src_, dst_, w_, comm, vdeg_, constant):
                 return step_fn(buckets, heavy, self_loop, comm, vdeg_,
-                               constant)
+                               constant, *plan_args)
 
             self._step = _step
             self._call = _bucketed_sharded_call(step_fn)
-            self._bucket_extra = (buckets, heavy, self_loop)
+            self._bucket_extra = (buckets, heavy, self_loop) + plan_args
             self.src = self.dst = self.w = None
         elif engine in ("bucketed", "pallas"):
             # The bucket matrices replace the edge slab entirely: don't
@@ -353,8 +389,9 @@ class PhaseRunner:
         tw = dg.graph.total_edge_weight_twice()
         self.constant = jnp.asarray(1.0 / tw, dtype=wdt)
         if self._bucket_extra is not None:
-            b, h, sl = self._bucket_extra
-            self._extra = (b, h, sl, self.vdeg, self.constant)
+            b, h, sl = self._bucket_extra[:3]
+            self._extra = (b, h, sl, self.vdeg, self.constant) \
+                + tuple(self._bucket_extra[3:])
         else:
             self._extra = (self.src, self.dst, self.w, self.vdeg,
                            self.constant)
@@ -367,8 +404,11 @@ class PhaseRunner:
         et_delta: float = 0.25,
         color_classes=None,
         n_color_classes: int = 0,
-    ) -> tuple[np.ndarray, float, int]:
-        """One phase: returns (communities in padded space, modularity, iters).
+    ) -> tuple[np.ndarray, float, int, bool]:
+        """One phase: returns (communities in padded space, modularity,
+        iters, overflow) — ``overflow`` True means a sparse-exchange budget
+        overflow invalidated the sweep and the caller must re-run the phase
+        with a larger budget (see louvain_phases' retry loop).
 
         Semantics of louvain.cpp:471-588: iterate until the modularity gain
         drops below `threshold`; return the assignment *before* the last two
@@ -403,18 +443,19 @@ class PhaseRunner:
             # convergence check inside (one host sync per phase instead of
             # one per iteration).
             wdt = self.constant.dtype
-            past_d, prev_mod_d, iters_d = _run_phase_loop(
+            past_d, prev_mod_d, iters_d, ovf_d = _run_phase_loop(
                 self._extra, self.comm0,
                 jnp.asarray(threshold, dtype=wdt),
                 jnp.asarray(lower, dtype=wdt),
                 call=self._call, max_iters=MAX_TOTAL_ITERATIONS,
             )
             return (np.asarray(jax.device_get(past_d)), float(prev_mod_d),
-                    int(iters_d))
+                    int(iters_d), bool(ovf_d))
         comm = self.comm0
         past = comm
         prev_mod = lower
         iters = 0
+        overflow = False
         et_stop = et_mode in (3, 4)
         if et_mode:
             active = self.real_mask_dev
@@ -424,9 +465,10 @@ class PhaseRunner:
         while True:
             iters += 1
             if color_classes is None:
-                target, mod, _ = self._step(
+                target, mod, _, ovf = self._step(
                     self.src, self.dst, self.w, comm, self.vdeg, self.constant
                 )
+                overflow |= bool(ovf)
             else:
                 # Color-class sweep: class c's moves are visible to class
                 # c+1 within the same iteration (louvain.cpp:862-901).
@@ -435,10 +477,11 @@ class PhaseRunner:
                 work = comm
                 mod = None
                 for c in range(n_color_classes):
-                    tgt_c, mod_c, _ = self._step(
+                    tgt_c, mod_c, _, ovf = self._step(
                         self.src, self.dst, self.w, work, self.vdeg,
                         self.constant,
                     )
+                    overflow |= bool(ovf)
                     if mod is None:
                         mod = mod_c  # modularity of the iteration's input
                     mask = color_classes == c
@@ -468,7 +511,7 @@ class PhaseRunner:
             comm = target
             if iters >= MAX_TOTAL_ITERATIONS:
                 break
-        return np.asarray(jax.device_get(past)), prev_mod, iters
+        return np.asarray(jax.device_get(past)), prev_mod, iters, overflow
 
 
 def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
@@ -553,6 +596,8 @@ def louvain_phases(
     engine: str = "auto",
     coloring: int = 0,
     vertex_ordering: int = 0,
+    exchange: str = "sparse",
+    exchange_budget: int | None = None,
     max_phases: int = TERMINATION_PHASE_COUNT,
     verbose: bool = False,
     tracer=None,
@@ -616,6 +661,9 @@ def louvain_phases(
     t_start = time.perf_counter()
     phase = 0
     g = graph
+    # Sparse-exchange per-peer budget, sticky across phases (grows on
+    # overflow retry; None = PhaseRunner's default of max(128, nv_pad/4)).
+    budget = exchange_budget
 
     if resume and checkpoint_dir:
         from cuvite_tpu.utils.checkpoint import load_latest
@@ -686,13 +734,34 @@ def louvain_phases(
                 color_dev = (shard_1d(mesh, cpad) if mesh is not None
                              else jnp.asarray(cpad))
 
-        with tracer.stage("plan"):
-            runner = PhaseRunner(dg, mesh=mesh, engine=engine)
-        with tracer.stage("iterate"):
-            comm_pad, curr_mod, iters = runner.run(
-                th, lower=-1.0, et_mode=et_mode, et_delta=et_delta,
-                color_classes=color_dev, n_color_classes=n_classes,
-            )
+        runner = None
+
+        def _run_with_budget(run_threshold, **run_kw):
+            # Sparse-exchange phases whose per-peer community budget
+            # overflows are re-run with a grown budget; budget == nv_pad
+            # covers the worst case, so the retry always terminates.  The
+            # runner (plans + device uploads) is reused across calls within
+            # a phase and rebuilt only when the budget actually grew.
+            nonlocal budget, runner
+            while True:
+                if runner is None:
+                    with tracer.stage("plan"):
+                        runner = PhaseRunner(dg, mesh=mesh, engine=engine,
+                                             budget=budget, exchange=exchange)
+                with tracer.stage("iterate"):
+                    cp, cm, it, ovf = runner.run(run_threshold, **run_kw)
+                if not ovf:
+                    return cp, cm, it
+                budget = min(dg.nv_pad, max(4 * (runner.budget or 128), 512))
+                runner = None
+                if verbose:
+                    print(f"sparse-exchange budget overflow; retrying phase "
+                          f"{phase} with budget {budget}")
+
+        comm_pad, curr_mod, iters = _run_with_budget(
+            th, lower=-1.0, et_mode=et_mode, et_delta=et_delta,
+            color_classes=color_dev, n_color_classes=n_classes,
+        )
         t2 = time.perf_counter()
         tot_iters += iters
         tracer.count("traversed_edges", g.num_edges * iters)
@@ -742,7 +811,8 @@ def louvain_phases(
             # of the identity assignment — terminates immediately and the
             # pass is dead.
             if threshold_cycling and not one_phase and phase < 10 and th > 1.0e-6:
-                comm_pad, curr_mod, iters = runner.run(1.0e-6, lower=-1.0)
+                comm_pad, curr_mod, iters = _run_with_budget(
+                    1.0e-6, lower=-1.0)
                 tot_iters += iters
                 comm_old = comm_pad[dg.old_to_pad]
                 if (curr_mod - prev_mod) > 1.0e-6:

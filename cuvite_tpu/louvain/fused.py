@@ -53,7 +53,7 @@ def _fused_step_call(nv_pad, accum_dtype):
             src, dst, w, comm, vdeg, constant,
             nv_total=nv_pad, axis_name=None, accum_dtype=accum_dtype,
         )
-        return out.target, out.modularity, out.n_moved
+        return out.target, out.modularity, out.n_moved, jnp.zeros((), bool)
 
     return call
 
@@ -110,7 +110,7 @@ def fused_louvain(src, dst, w, thresholds, constant, real_mask, *,
          mod_hist, iter_hist, nc_hist, _, _done) = state
         vdeg = seg.segment_sum(w, src, num_segments=nv_pad, sorted_ids=True)
         th = thresholds[jnp.minimum(phase, max_phases - 1)]
-        past, mod, iters = _phase_iterations(
+        past, mod, iters, _ = _phase_iterations(
             src, dst, w, vdeg, constant, th, lower,
             nv_pad=nv_pad, accum_dtype=accum_dtype,
             max_iters=MAX_TOTAL_ITERATIONS,
@@ -176,7 +176,7 @@ def fused_louvain(src, dst, w, thresholds, constant, real_mask, *,
                 phase = args
             vdeg = seg.segment_sum(w_f, src_f, num_segments=nv_pad,
                                    sorted_ids=True)
-            past, mod, iters = _phase_iterations(
+            past, mod, iters, _ = _phase_iterations(
                 src_f, dst_f, w_f, vdeg, constant,
                 jnp.asarray(1e-6, dtype=wdt), lower,
                 nv_pad=nv_pad, accum_dtype=accum_dtype,

@@ -146,7 +146,7 @@ def make_sharded_step(mesh: Mesh, axis_name: str, nv_total: int,
         mesh=mesh,
         in_specs=(P(axis_name), P(axis_name), P(axis_name), P(axis_name),
                   P(axis_name), P()),
-        out_specs=(P(axis_name), P(), P()),
+        out_specs=(P(axis_name), P(), P(), P()),
         check_vma=False,
     )
     def step(src, dst, w, comm, vdeg, constant):
@@ -154,7 +154,9 @@ def make_sharded_step(mesh: Mesh, axis_name: str, nv_total: int,
             src, dst, w, comm, vdeg, constant,
             nv_total=nv_total, axis_name=axis_name, accum_dtype=accum_dtype,
         )
-        return out.target, out.modularity, out.n_moved
+        # Uniform step contract: (target, modularity, n_moved, overflow);
+        # the replicated exchange can never overflow.
+        return out.target, out.modularity, out.n_moved, jnp.zeros((), bool)
 
     return jax.jit(step)
 
@@ -167,6 +169,6 @@ def make_single_step(nv_total: int, accum_dtype=None):
             src, dst, w, comm, vdeg, constant,
             nv_total=nv_total, axis_name=None, accum_dtype=accum_dtype,
         )
-        return out.target, out.modularity, out.n_moved
+        return out.target, out.modularity, out.n_moved, jnp.zeros((), bool)
 
     return jax.jit(step)

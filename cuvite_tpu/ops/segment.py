@@ -59,14 +59,15 @@ def modularity_terms(counter0, comm_deg, constant, gsum, accum_dtype):
     return le_xx * c_acc - la2_x * c_acc * c_acc
 
 
-def sort_edges_by_vertex_comm(src, ckey, w):
+def sort_edges_by_vertex_comm(src, ckey, w, *extras):
     """Lexicographic sort of the edge slab by (src, ckey).
 
-    Returns (src_s, ckey_s, w_s).  Padding edges carry src == nv_pad (max
-    segment id) and therefore sort to the tail of the slab.
+    Returns (src_s, ckey_s, w_s, *extras_s) — any ``extras`` arrays are
+    co-sorted as additional payload channels (used by the sparse exchange to
+    carry per-slot community degree/size).  Padding edges carry src == nv_pad
+    (max segment id) and therefore sort to the tail of the slab.
     """
-    src_s, ckey_s, w_s = jax.lax.sort((src, ckey, w), num_keys=2)
-    return src_s, ckey_s, w_s
+    return jax.lax.sort((src, ckey, w) + extras, num_keys=2)
 
 
 def run_starts(src_s, ckey_s):

@@ -20,8 +20,8 @@ def _run_engines_one_phase(graph, iters=4):
         comm = r.comm0
         trace = []
         for _ in range(iters):
-            target, q, moved = r._step(r.src, r.dst, r.w, comm, r.vdeg,
-                                       r.constant)
+            target, q, moved, _ = r._step(r.src, r.dst, r.w, comm, r.vdeg,
+                                          r.constant)
             trace.append((np.asarray(target), float(q), int(moved)))
             comm = target
         outs.append(trace)
@@ -122,9 +122,9 @@ def test_heavy_path_and_chunking_with_small_widths():
     ref_step = make_single_step(nvt)
     src, dst, w = dg.stacked_edges()
     for it in range(3):
-        t1, q1, m1 = ref_step(jnp.asarray(src), jnp.asarray(dst),
+        t1, q1, m1, _ = ref_step(jnp.asarray(src), jnp.asarray(dst),
                               jnp.asarray(w), comm, vdeg, const)
-        t2, q2, m2 = bucketed_step(buckets, heavy, sl, comm, vdeg, const,
+        t2, q2, m2, _ = bucketed_step(buckets, heavy, sl, comm, vdeg, const,
                                    nv_total=nvt, sentinel=np.iinfo(vdt).max)
         np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2),
                                       err_msg=f"iter {it}")
@@ -146,9 +146,9 @@ def test_heavy_path_and_chunking_with_small_widths():
                   jnp.asarray(plan2.heavy_dst.astype(vdt)),
                   jnp.asarray(plan2.heavy_w.astype(wdt)))
         comm = jnp.arange(nvt, dtype=vdt)
-        t3, q3, _ = bucketed_step(buckets2, heavy2, sl, comm, vdeg, const,
+        t3, q3, _, _ = bucketed_step(buckets2, heavy2, sl, comm, vdeg, const,
                                   nv_total=nvt, sentinel=np.iinfo(vdt).max)
-        t0, q0, _ = ref_step(jnp.asarray(src), jnp.asarray(dst),
+        t0, q0, _, _ = ref_step(jnp.asarray(src), jnp.asarray(dst),
                              jnp.asarray(w), comm, vdeg, const)
         np.testing.assert_array_equal(np.asarray(t0), np.asarray(t3))
     finally:
@@ -170,7 +170,9 @@ def test_multishard_bucketed_matches_single(nshards):
     r = PhaseRunner(dg, mesh=mesh, engine="bucketed")
     comm = r.comm0
     for it, (t1, q1, m1) in enumerate(single):
-        target, q, moved = r._step(None, None, None, comm, r.vdeg, r.constant)
+        target, q, moved, ovf = r._step(None, None, None, comm, r.vdeg,
+                                        r.constant)
+        assert not bool(ovf), "sparse budget overflow in test"
         # Labels are padded-space vertex ids and the padded layouts differ
         # per nshards: map each to original-id space, compare as partitions.
         lab1 = dg1.pad_to_old[t1[dg1.old_to_pad]]

@@ -1,0 +1,125 @@
+"""Sparse ghost exchange: plan correctness, equality with the replicated
+exchange, and the budget-overflow retry path.
+
+The sparse path is the analog of the reference's exchangeVertexReqs /
+fillRemoteCommunities / updateRemoteCommunities protocol
+(/root/reference/louvain.cpp:3118-3264, :2588-2959, :2983-3116); these tests
+pin (a) the phase-static routing plan against a numpy oracle, (b) trajectory
+equality sparse == replicated == single-shard, and (c) that an undersized
+per-peer budget is detected and the driver's retry converges to the same
+answer.
+"""
+
+import numpy as np
+import pytest
+
+from cuvite_tpu.comm.exchange import ExchangePlan
+from cuvite_tpu.comm.mesh import make_mesh
+from cuvite_tpu.core.distgraph import DistGraph
+from cuvite_tpu.io.generate import generate_rgg, generate_rmat
+from cuvite_tpu.louvain.driver import PhaseRunner, louvain_phases
+
+
+@pytest.fixture(scope="module")
+def rmat9():
+    return generate_rmat(9, edge_factor=8, seed=2)
+
+
+def test_plan_ghosts_match_oracle(rmat9):
+    dg = DistGraph.build(rmat9, 4)
+    plan = ExchangePlan.build(dg)
+    nvp = dg.nv_pad
+    for s, sh in enumerate(dg.shards):
+        src = np.asarray(sh.src)
+        dst = np.asarray(sh.dst).astype(np.int64)
+        real = src < nvp
+        d = dst[real]
+        expect = np.unique(d[(d < s * nvp) | (d >= (s + 1) * nvp)])
+        np.testing.assert_array_equal(plan.ghost_ids[s], expect)
+    # send_idx consistency: shard t's row for requester s lists exactly the
+    # local indices of s's ghosts owned by t, in ghost order.
+    for s in range(dg.nshards):
+        gids = plan.ghost_ids[s]
+        for t in range(dg.nshards):
+            mine = gids[(gids >= t * nvp) & (gids < (t + 1) * nvp)]
+            row = plan.send_idx[t, s]
+            row = row[row < nvp]
+            np.testing.assert_array_equal(row, mine - t * nvp)
+
+
+def test_remap_preserves_community_lookup(rmat9):
+    """comm_ext[dst_remapped] must equal comm_full[dst_global] for every
+    real edge — the invariant the whole exchange relies on."""
+    dg = DistGraph.build(rmat9, 4)
+    plan = ExchangePlan.build(dg)
+    nvp = dg.nv_pad
+    rng = np.random.default_rng(3)
+    comm_full = rng.integers(0, dg.total_padded_vertices,
+                             size=dg.total_padded_vertices)
+    for s, sh in enumerate(dg.shards):
+        src = np.asarray(sh.src)
+        dst = np.asarray(sh.dst).astype(np.int64)
+        ext = plan.remap_dst(s, src, dst)
+        gids = plan.ghost_ids[s]
+        ghost_vals = comm_full[gids] if len(gids) else np.zeros(0, np.int64)
+        table = np.concatenate([
+            comm_full[s * nvp:(s + 1) * nvp],
+            ghost_vals,
+            np.zeros(plan.ghost_pad - len(gids), dtype=np.int64),
+        ])
+        real = src < nvp
+        np.testing.assert_array_equal(table[ext[real]], comm_full[dst[real]])
+
+
+@pytest.mark.parametrize("nshards", [2, 8])
+def test_sparse_equals_replicated_trajectory(rmat9, nshards):
+    mesh = make_mesh(nshards)
+    outs = {}
+    for exchange in ("replicated", "sparse"):
+        dg = DistGraph.build(rmat9, nshards)
+        r = PhaseRunner(dg, mesh=mesh, engine="bucketed", exchange=exchange)
+        comm = r.comm0
+        trace = []
+        for _ in range(4):
+            out = r._step(None, None, None, comm, r.vdeg, r.constant)
+            if len(out) > 3:
+                assert not bool(out[3])
+            trace.append((np.asarray(out[0]), float(out[1]), int(out[2])))
+            comm = out[0]
+        outs[exchange] = trace
+    for it, ((t1, q1, m1), (t2, q2, m2)) in enumerate(
+            zip(outs["replicated"], outs["sparse"])):
+        np.testing.assert_array_equal(t1, t2, err_msg=f"iter {it}")
+        assert q2 == pytest.approx(q1, abs=1e-5)
+        assert m1 == m2
+
+
+def test_tiny_budget_overflows_and_driver_retries(rmat9):
+    nshards = 4
+    mesh = make_mesh(nshards)
+    dg = DistGraph.build(rmat9, nshards)
+    r = PhaseRunner(dg, mesh=mesh, engine="bucketed", budget=1)
+    comm = r.comm0
+    ovf_seen = False
+    # Iteration 1 references no remote communities (comm[v] == v), so sweep
+    # a few iterations until cross-shard merges need more than one entry.
+    for _ in range(4):
+        out = r._step(None, None, None, comm, r.vdeg, r.constant)
+        ovf_seen |= bool(out[3])
+        comm = out[0]
+    assert ovf_seen, "budget=1 should overflow once communities span shards"
+
+    # The driver retries with a grown budget and must land on the same
+    # communities as the single-shard run.
+    r1 = louvain_phases(rmat9, engine="bucketed")
+    rN = louvain_phases(rmat9, nshards=nshards, engine="bucketed",
+                        exchange_budget=1)
+    assert rN.modularity == pytest.approx(r1.modularity, abs=1e-4)
+
+
+def test_full_run_sparse_rgg_matches_single():
+    g = generate_rgg(512, seed=5)
+    r1 = louvain_phases(g, engine="bucketed")
+    rN = louvain_phases(g, nshards=8, engine="bucketed")
+    assert rN.modularity == pytest.approx(r1.modularity, abs=1e-4)
+    assert rN.num_communities == r1.num_communities

@@ -42,14 +42,13 @@ def test_row_argmax_pallas_matches_xla(width, seed):
     is_cc = cmat == curr[:, None]
     counter0 = np.sum(np.where(is_cc, wmat, 0.0), axis=1).astype(np.float32)
     eix = counter0 - sl
-    ref = _row_argmax(
-        jnp.asarray(cmat), jnp.asarray(wmat), jnp.asarray(curr),
-        jnp.asarray(vdeg), jnp.asarray(eix), jnp.asarray(comm_deg),
-        jnp.asarray(constant), SENTINEL,
-    )
-
     ay = comm_deg[cmat]                     # pre-gathered outside the kernel
     ax = comm_deg[curr] - vdeg
+    ref = _row_argmax(
+        jnp.asarray(cmat), jnp.asarray(wmat), jnp.asarray(ay), None,
+        jnp.asarray(curr), jnp.asarray(vdeg), jnp.asarray(eix),
+        jnp.asarray(ax), jnp.asarray(constant), SENTINEL,
+    )
     bc, bg, c0 = row_argmax_pallas(
         jnp.asarray(np.ascontiguousarray(cmat.T)),
         jnp.asarray(np.ascontiguousarray(wmat.T)),

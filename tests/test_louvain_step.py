@@ -79,11 +79,11 @@ def run_device_step(graph: Graph, comm: np.ndarray, nshards: int = 1):
     )
     if nshards == 1:
         step = make_single_step(nvt)
-        t, q, n = step(src, dst, w, comm_pad, vdeg, const)
+        t, q, n, _ = step(src, dst, w, comm_pad, vdeg, const)
     else:
         mesh = make_mesh(nshards)
         step = make_sharded_step(mesh, VERTEX_AXIS, nvt)
-        t, q, n = step(
+        t, q, n, _ = step(
             shard_1d(mesh, src), shard_1d(mesh, dst), shard_1d(mesh, w),
             shard_1d(mesh, comm_pad), shard_1d(mesh, vdeg), const,
         )
