@@ -43,6 +43,7 @@ from cuvite_tpu.louvain.bucketed import (
     build_stacked_plans,
     make_sharded_bucketed_step,
 )
+from cuvite_tpu.louvain.precise import phase_modularity
 from cuvite_tpu.louvain.step import make_sharded_step, make_single_step
 
 
@@ -97,6 +98,16 @@ def _device_dtype(dt: np.dtype) -> np.dtype:
 # same jitted callable (jax.jit caches compilations per callable object, so
 # recreating the closure each phase would retrace and recompile every time).
 _STEP_CACHE: dict = {}
+
+
+def _runner_slab(runner):
+    """Device-resident (src, dst, w) of a single-shard slab engine, or None
+    (bucketed engines hold no slab on device; never upload one just for the
+    phase-end modularity pass)."""
+    if runner is not None and runner.dg.nshards == 1 \
+            and runner.src is not None:
+        return (runner.src, runner.dst, runner.w)
+    return None
 
 
 def _get_step(mesh, nv_total: int, accum_dtype) -> object:
@@ -549,7 +560,9 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
             accum_dtype=adt,
             cycling=bool(threshold_cycling and not one_phase),
         )
-        (labels, prev_mod, n_phases, tot_iters, mod_hist, iter_hist,
+        # Slot 1 is the fused loop's own f32 converged modularity; the
+        # reported value is recomputed precisely below from `labels`.
+        (labels, _loop_mod, n_phases, tot_iters, mod_hist, iter_hist,
          nc_hist) = jax.device_get(out)
     total_s = time.perf_counter() - t_start
     tracer.count("traversed_edges", graph.num_edges * int(tot_iters))
@@ -576,7 +589,10 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
                   f"Iterations: {st.iterations}, nv: {st.num_vertices}")
     return LouvainResult(
         communities=dense_all,
-        modularity=float(prev_mod) if n_phases else -1.0,
+        # Final reported Q: double-single recompute on the final labels
+        # (the fused loop's own history stays f32).
+        modularity=phase_modularity(dg, np.asarray(labels)) if n_phases
+        else -1.0,
         phases=phases,
         total_iterations=tot_iters,
         total_seconds=total_s,
@@ -762,6 +778,14 @@ def louvain_phases(
             th, lower=-1.0, et_mode=et_mode, et_delta=et_delta,
             color_classes=color_dev, n_color_classes=n_classes,
         )
+        # The loop's f32 modularity decided convergence; the REPORTED value
+        # is recomputed once per phase with f64-class accuracy
+        # (louvain/precise.py) — the analog of the reference's double
+        # accumulation (louvain.cpp:2433-2481).  The device ds pass is used
+        # only when the slab is already resident (sort engine).
+        with tracer.stage("evaluate"):
+            curr_mod = phase_modularity(dg, comm_pad,
+                                        device_slab=_runner_slab(runner))
         t2 = time.perf_counter()
         tot_iters += iters
         tracer.count("traversed_edges", g.num_edges * iters)
@@ -813,6 +837,9 @@ def louvain_phases(
             if threshold_cycling and not one_phase and phase < 10 and th > 1.0e-6:
                 comm_pad, curr_mod, iters = _run_with_budget(
                     1.0e-6, lower=-1.0)
+                with tracer.stage("evaluate"):
+                    curr_mod = phase_modularity(dg, comm_pad,
+                                                device_slab=_runner_slab(runner))
                 tot_iters += iters
                 comm_old = comm_pad[dg.old_to_pad]
                 if (curr_mod - prev_mod) > 1.0e-6:

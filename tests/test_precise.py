@@ -1,0 +1,87 @@
+"""Double-single accumulation: unit tests for the ds primitives and the
+1e-9-class accuracy gate for per-phase modularity (VERDICT round-1 item 4;
+analog of the reference's double accumulation, louvain.cpp:2433-2481)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cuvite_tpu.core.distgraph import DistGraph
+from cuvite_tpu.evaluate.modularity import modularity as host_mod
+from cuvite_tpu.io.generate import generate_rmat
+from cuvite_tpu.louvain.driver import louvain_phases
+from cuvite_tpu.louvain.precise import phase_modularity
+from cuvite_tpu.ops import exactsum as ds
+
+
+def test_ds_tree_sum_beats_f32():
+    """Adversarial mix of magnitudes: ds must track the f64 oracle far
+    beyond f32's 2^-24."""
+    rng = np.random.default_rng(0)
+    x = np.concatenate([
+        rng.uniform(1e6, 1e7, 4096),
+        rng.uniform(1e-3, 1e-2, 4096),
+    ]).astype(np.float32)
+    rng.shuffle(x)
+    hi, lo = ds.ds_tree_sum(jnp.asarray(x))
+    got = ds.ds_to_f64((hi, lo))
+    want = float(np.sum(x.astype(np.float64)))
+    f32 = float(np.sum(x))
+    assert abs(got - want) <= 1e-9 * abs(want)
+    assert abs(got - want) < abs(f32 - want)  # strictly better than f32
+
+
+def test_ds_mul_exactness():
+    a, b = np.float32(16777217.0 / 16.0), np.float32(3.0000001)
+    hi, lo = ds.ds_mul(ds.ds_from_f32(jnp.float32(a)),
+                       ds.ds_from_f32(jnp.float32(b)))
+    want = float(np.float64(a) * np.float64(b))
+    assert abs(ds.ds_to_f64((hi, lo)) - want) <= 1e-14 * abs(want)
+
+
+def test_ds_segment_sums_sorted_matches_f64():
+    rng = np.random.default_rng(1)
+    keys = np.sort(rng.integers(0, 50, 8192)).astype(np.int32)
+    vals = rng.uniform(1e-3, 1e5, 8192).astype(np.float32)
+    run_hi, run_lo, last = ds.ds_segment_sums_sorted(
+        jnp.asarray(keys), jnp.asarray(vals))
+    run_hi, run_lo, last = map(np.asarray, (run_hi, run_lo, last))
+    want = np.zeros(50)
+    np.add.at(want, keys, vals.astype(np.float64))
+    got = {}
+    for i in np.nonzero(last)[0]:
+        got[keys[i]] = np.float64(run_hi[i]) + np.float64(run_lo[i])
+    for k, w in got.items():
+        assert abs(w - want[k]) <= 1e-9 * max(abs(want[k]), 1.0)
+
+
+@pytest.mark.parametrize("scale", [16, 20])
+def test_phase_modularity_matches_f64_oracle(scale):
+    """Device ds modularity vs host f64 oracle within 1e-9*|Q| — scale-20
+    R-MAT with f32 (unit) weights is the VERDICT acceptance case."""
+    g = generate_rmat(scale, edge_factor=16, seed=1)
+    dg = DistGraph.build(g, 1)
+    # Non-trivial synthetic assignment with big skewed communities: maps
+    # every vertex to one of ~1000 communities (padded space).
+    rng = np.random.default_rng(2)
+    labels = rng.integers(0, 1000, g.num_vertices)
+    comm_pad = np.arange(dg.total_padded_vertices, dtype=np.int64)
+    comm_pad[dg.old_to_pad] = dg.old_to_pad[labels]
+    got = phase_modularity(dg, comm_pad)
+    want = host_mod(g, labels)
+    assert abs(got - want) <= 1e-9 * abs(want), (got, want)
+
+
+def test_reported_modularity_is_precise_end_to_end():
+    g = generate_rmat(13, edge_factor=8, seed=3)
+    res = louvain_phases(g, engine="bucketed")
+    want = host_mod(g, res.communities)
+    assert abs(res.modularity - want) <= 1e-9 * abs(want)
+
+
+def test_multishard_reported_modularity_is_precise():
+    g = generate_rmat(11, edge_factor=8, seed=4)
+    res = louvain_phases(g, nshards=4)
+    want = host_mod(g, res.communities)
+    assert abs(res.modularity - want) <= 1e-9 * abs(want)
