@@ -20,6 +20,14 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     if devices is None:
         devices = jax.devices()
         if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"requested a {n_devices}-device mesh but only "
+                    f"{len(devices)} jax device(s) are visible; for a "
+                    f"virtual CPU mesh set XLA_FLAGS="
+                    f"--xla_force_host_platform_device_count={n_devices} "
+                    f"before jax initializes"
+                )
             devices = devices[:n_devices]
     return Mesh(np.array(devices), (VERTEX_AXIS,))
 

@@ -390,10 +390,40 @@ def _rows_chunked(cmat, w_mat, dst_mat, curr, vdeg_v, eix_v, ax_v,
     )
 
 
+def bucketed_modularity(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
+                        constant, *, nv_total, accum_dtype=None):
+    """Modularity of ``comm`` alone (no argmax): one cheap masked-sum pass
+    over the bucket rows + heavy slab.  Used by the color-scheduled
+    iteration, whose per-class steps see partial states — this gives the
+    iteration's Q at its START state for the convergence check at ~the cost
+    of the counter0 pass (single-shard)."""
+    nv_local = comm.shape[0]
+    wdt = vdeg.dtype
+    comm_deg = seg.segment_sum(vdeg, comm, num_segments=nv_total)
+    counter0 = jnp.zeros((nv_local,), dtype=wdt)
+    hs, hd, hw = heavy_arrays
+    ckey_h = jnp.take(comm, hd)
+    csrc_h = jnp.take(comm, jnp.minimum(hs, nv_local - 1))
+    counter0 = counter0 + seg.segment_sum(
+        jnp.where(ckey_h == csrc_h, hw, jnp.zeros_like(hw)), hs,
+        num_segments=nv_local,
+    )
+    for verts, dst_mat, w_mat in bucket_arrays:
+        safe_v = jnp.minimum(verts, nv_local - 1)
+        curr = jnp.take(comm, safe_v)
+        cmat = jnp.take(comm, dst_mat)
+        c0_rows = jnp.sum(
+            jnp.where(cmat == curr[:, None], w_mat, 0.0), axis=1
+        ).astype(wdt)
+        counter0 = counter0.at[verts].add(c0_rows, mode="drop")
+    return seg.modularity_terms(counter0, comm_deg, constant,
+                                lambda x: x, accum_dtype)
+
+
 def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
                   constant, *, nv_total, sentinel, accum_dtype=None,
                   axis_name=None, pallas_flags=(), pallas_interpret=False,
-                  sparse_plan=None, nshards=1, budget=0):
+                  sparse_plan=None, nshards=1, budget=0, info_comm=None):
     """Full Louvain sweep over one shard using the bucketed engine.
 
     ``bucket_arrays`` is a tuple of (verts, dst_mat, w_mat) triples (one per
@@ -422,12 +452,21 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
       community degree/size ride the phase-static ghost routing, community
       info is sharded by owner and resolved through the budgeted
       owner-reduce (cuvite_tpu/comm/exchange.py) — O(owned + ghosts).
+
+    ``info_comm``: optional FROZEN assignment used only for the community
+    degree/size tables — the vertex-ordering schedule (reference -d,
+    /root/reference/louvain.cpp:1535-1562) hoists the community-info
+    exchange out of the color loop, so later classes see earlier classes'
+    ``comm`` updates but iteration-start community info.  Replicated
+    single-shard path only.
     """
     nv_local = comm.shape[0]
     wdt = vdeg.dtype
     vdt = comm.dtype
 
     use_sparse = sparse_plan is not None
+    assert info_comm is None or not use_sparse, \
+        "info_comm (vertex ordering) is a replicated-exchange feature"
     if use_sparse:
         from cuvite_tpu.comm.exchange import sparse_env, sparse_modularity
 
@@ -446,9 +485,10 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
     else:
         env = None
         comm_ref, gsum = seg.spmd_env(comm, axis_name)
-        comm_deg = gsum(seg.segment_sum(vdeg, comm, num_segments=nv_total))
+        info = comm if info_comm is None else info_comm
+        comm_deg = gsum(seg.segment_sum(vdeg, info, num_segments=nv_total))
         comm_size = gsum(seg.segment_sum(
-            jnp.ones((nv_local,), dtype=vdt), comm, num_segments=nv_total
+            jnp.ones((nv_local,), dtype=vdt), info, num_segments=nv_total
         ))
         overflow = jnp.zeros((), dtype=bool)  # replicated: can't overflow
 

@@ -141,6 +141,35 @@ def _bucketed_jit(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
                        constant))
 
 
+@functools.partial(
+    jax.jit, static_argnames=("nv_total", "sentinel", "accum_dtype"),
+)
+def _bucketed_class_jit(bucket_arrays, heavy_arrays, self_loop, comm,
+                        info_comm, vdeg, constant, *, nv_total, sentinel,
+                        accum_dtype):
+    """Class-restricted sweep: the plan covers one color class's vertices;
+    ``info_comm`` (may alias comm) freezes the community-info tables for
+    the vertex-ordering schedule."""
+    from cuvite_tpu.louvain.bucketed import bucketed_step
+
+    return bucketed_step(
+        bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant,
+        nv_total=nv_total, sentinel=sentinel, accum_dtype=accum_dtype,
+        info_comm=info_comm,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("nv_total", "accum_dtype"))
+def _bucketed_mod_jit(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
+                      constant, *, nv_total, accum_dtype):
+    from cuvite_tpu.louvain.bucketed import bucketed_modularity
+
+    return bucketed_modularity(
+        bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant,
+        nv_total=nv_total, accum_dtype=accum_dtype,
+    )
+
+
 # ---------------------------------------------------------------------------
 # On-device phase loop.
 #
@@ -178,6 +207,68 @@ def _run_phase_loop(extra, comm0, threshold, lower, *, call, max_iters):
     init = (comm0, comm0, lower, jnp.int32(0), jnp.bool_(False),
             jnp.zeros((), dtype=bool))
     past, _, prev_mod, iters, _, ovf = jax.lax.while_loop(cond, body, init)
+    return past, prev_mod, iters, ovf
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("call", "max_iters", "et_mode", "nv_real"),
+)
+def _run_phase_loop_et(extra, comm0, threshold, lower, active0, et_delta,
+                       *, call, max_iters, et_mode, nv_real):
+    """On-device phase loop with early-termination state in the carry
+    (VERDICT round-1 item 10): freeze masks / decay probabilities update on
+    device, so ET modes 1-4 cost ONE host sync per phase like the default
+    path (the reference syncs per iteration; cf. louvain.cpp:7-423).
+
+    Semantics match PhaseRunner.run's host ET loop exactly: targets masked
+    by ``active``; freeze updates applied from iteration 3 on, only when
+    the loop continues; modes 3/4 stop once >= ET_CUTOFF of real vertices
+    are frozen (checked before the threshold test, like the host loop).
+    """
+    wdt = lower.dtype
+    et_stop = et_mode in (3, 4)
+    prob = et_mode in (2, 4)
+
+    def cond(c):
+        return ~c[4]
+
+    def body(c):
+        past, comm, prev_mod, iters, _, ovf, active, p_act = c
+        target, mod, _, step_ovf = call(comm, extra)
+        target = jnp.where(active, target, comm)
+        mod = mod.astype(wdt)
+        iters1 = iters + 1
+        if et_stop:
+            frozen = nv_real - jnp.sum(active.astype(jnp.int32))
+            frozen_stop = frozen.astype(wdt) >= wdt.type(ET_CUTOFF * nv_real)
+        else:
+            frozen_stop = jnp.bool_(False)
+        no_gain = (mod - prev_mod) < threshold
+        stop = no_gain | frozen_stop | (iters1 >= max_iters)
+        cont = ~(no_gain | frozen_stop)
+        upd = cont & (iters1 > 2)
+        if prob:
+            decayed = active & (comm == past)
+            p_new = jnp.where(upd & decayed, p_act * (1.0 - et_delta),
+                              p_act)
+            freeze = decayed & (p_new <= P_CUTOFF)
+            active_new = jnp.where(upd, active & ~freeze, active)
+            p_act = p_new
+        else:
+            stable = (target == comm) & (comm == past)
+            active_new = jnp.where(upd, active & ~stable, active)
+        new_prev = jnp.where(cont, jnp.maximum(mod, lower), prev_mod)
+        new_past = jnp.where(cont, comm, past)
+        new_comm = jnp.where(cont, target, comm)
+        return (new_past, new_comm, new_prev, iters1, stop,
+                ovf | step_ovf, active_new, p_act)
+
+    p0 = jnp.ones_like(comm0, dtype=wdt)
+    init = (comm0, comm0, lower, jnp.int32(0), jnp.bool_(False),
+            jnp.zeros((), dtype=bool), active0, p0)
+    past, _, prev_mod, iters, _, ovf, _, _ = jax.lax.while_loop(
+        cond, body, init)
     return past, prev_mod, iters, ovf
 
 
@@ -228,7 +319,9 @@ class PhaseRunner:
     """
 
     def __init__(self, dg: DistGraph, mesh=None, engine: str = "sort",
-                 budget: int | None = None, exchange: str = "sparse"):
+                 budget: int | None = None, exchange: str = "sparse",
+                 color_local=None, n_color_classes: int = 0,
+                 ordering: bool = False):
         if engine not in ("sort", "bucketed", "pallas"):
             raise ValueError(f"unknown engine {engine!r}; use 'sort', "
                              "'bucketed' or 'pallas' ('auto' is resolved "
@@ -239,6 +332,9 @@ class PhaseRunner:
         self.mesh = mesh
         self.engine = engine
         self.budget = None
+        self._class_plans = None    # per-color-class bucket plans
+        self._mod_args = None       # full-plan args for _bucketed_mod_jit
+        self.ordering = bool(ordering)
         nv_total = dg.total_padded_vertices
         vdeg = dg.padded_weighted_degrees()
         vdt = _device_dtype(dg.graph.policy.vertex_dtype)
@@ -371,6 +467,46 @@ class PhaseRunner:
                                         interp)
             self._bucket_extra = (buckets, heavy, self_loop)
             self.src = self.dst = self.w = None
+            if color_local is not None and n_color_classes > 0:
+                # Per-class bucket plans: each color class's sweep touches
+                # ONLY its vertices' rows, so one full iteration costs ~one
+                # sweep total instead of n_classes full sweeps (the analog
+                # of the reference sweeping class vertices only,
+                # /root/reference/louvain.cpp:862-901).  Edges of other
+                # classes are masked to padding before plan construction.
+                src_np = np.asarray(sh.src)
+                dst_np = np.asarray(sh.dst)
+                w_np = np.asarray(sh.w)
+                cls = np.asarray(color_local)
+                real = src_np < dg.nv_pad
+                src_cls = np.where(
+                    real, cls[np.minimum(src_np, dg.nv_pad - 1)], -1)
+                self._class_plans = []
+                for c in range(n_color_classes):
+                    src_c = np.where(src_cls == c, src_np,
+                                     dg.nv_pad).astype(src_np.dtype)
+                    pc = BucketPlan.build(src_c, dst_np, w_np,
+                                          nv_local=dg.nv_pad, base=0)
+                    bk = tuple((jnp.asarray(b.verts.astype(vdt)),
+                                jnp.asarray(b.dst.astype(vdt)),
+                                jnp.asarray(b.w.astype(wdt)))
+                               for b in pc.buckets)
+                    hv = (jnp.asarray(pc.heavy_src.astype(vdt)),
+                          jnp.asarray(pc.heavy_dst.astype(vdt)),
+                          jnp.asarray(pc.heavy_w.astype(wdt)))
+                    self._class_plans.append(
+                        (bk, hv, jnp.asarray(pc.self_loop.astype(wdt))))
+                # non-pallas full plan for the per-iteration modularity pass
+                mod_buckets = tuple(
+                    (jnp.asarray(b.verts.astype(vdt)),
+                     jnp.asarray(b.dst.astype(vdt)),
+                     jnp.asarray(b.w.astype(wdt)))
+                    for b in plan.buckets
+                ) if use_pallas else buckets
+                self._mod_args = (mod_buckets, heavy, self_loop)
+                self._nv_total = nv_total
+                self._sentinel = sentinel
+                self._adt = adt_np
         else:
             self._step = _get_step(mesh, nv_total, adt)
             self._call = _step_call(self._step)
@@ -449,7 +585,8 @@ class PhaseRunner:
         n_color_classes full sweeps (typically fewer iterations in
         exchange); per-class bucket subsets are the planned optimization.
         """
-        if et_mode == 0 and color_classes is None:
+        if et_mode == 0 and color_classes is None \
+                and self._class_plans is None:
             # Default path: the whole iteration loop runs on device with the
             # convergence check inside (one host sync per phase instead of
             # one per iteration).
@@ -459,6 +596,22 @@ class PhaseRunner:
                 jnp.asarray(threshold, dtype=wdt),
                 jnp.asarray(lower, dtype=wdt),
                 call=self._call, max_iters=MAX_TOTAL_ITERATIONS,
+            )
+            return (np.asarray(jax.device_get(past_d)), float(prev_mod_d),
+                    int(iters_d), bool(ovf_d))
+        if color_classes is None and self._class_plans is None:
+            # ET modes 1-4 without coloring: freeze state lives in the
+            # device loop's carry — one host sync per phase, like the
+            # default path.
+            wdt = self.constant.dtype
+            past_d, prev_mod_d, iters_d, ovf_d = _run_phase_loop_et(
+                self._extra, self.comm0,
+                jnp.asarray(threshold, dtype=wdt),
+                jnp.asarray(lower, dtype=wdt),
+                self.real_mask_dev,
+                jnp.asarray(et_delta, dtype=wdt),
+                call=self._call, max_iters=MAX_TOTAL_ITERATIONS,
+                et_mode=et_mode, nv_real=int(self.real_mask.sum()),
             )
             return (np.asarray(jax.device_get(past_d)), float(prev_mod_d),
                     int(iters_d), bool(ovf_d))
@@ -475,16 +628,42 @@ class PhaseRunner:
                 p_act = jnp.ones_like(self.vdeg)
         while True:
             iters += 1
-            if color_classes is None:
+            if color_classes is None and self._class_plans is None:
                 target, mod, _, ovf = self._step(
                     self.src, self.dst, self.w, comm, self.vdeg, self.constant
                 )
                 overflow |= bool(ovf)
+            elif self._class_plans is not None:
+                # Class-restricted sweeps: each class's step runs on ITS
+                # bucket plan only, so the whole iteration costs ~one sweep
+                # (plus one cheap counter0-only modularity pass for the
+                # convergence check).  Coloring refreshes community info per
+                # class commit (louvain.cpp:862-901); vertex ordering
+                # freezes it at the iteration start (louvain.cpp:1535-1562)
+                # so colors only ORDER the sequential commits.
+                mod = _bucketed_mod_jit(
+                    *self._mod_args, comm, self.vdeg, self.constant,
+                    nv_total=self._nv_total, accum_dtype=self._adt,
+                )
+                work = comm
+                snapshot = comm
+                for bk, hv, sl in self._class_plans:
+                    info = snapshot if self.ordering else work
+                    tgt_c, _mc, _nc, _oc = _bucketed_class_jit(
+                        bk, hv, sl, work, info, self.vdeg, self.constant,
+                        nv_total=self._nv_total, sentinel=self._sentinel,
+                        accum_dtype=self._adt,
+                    )
+                    if et_mode:
+                        tgt_c = jnp.where(active, tgt_c, work)
+                    work = tgt_c  # non-class vertices keep `work` values
+                target = work
             else:
-                # Color-class sweep: class c's moves are visible to class
-                # c+1 within the same iteration (louvain.cpp:862-901).
-                # Frozen (inactive) vertices must never enter `work`, or
-                # later classes would decide against phantom state.
+                # Legacy full-sweep color schedule (multi-shard / slab
+                # engines): class c's moves are visible to class c+1 within
+                # the same iteration.  Frozen (inactive) vertices must never
+                # enter `work`, or later classes would decide against
+                # phantom state.
                 work = comm
                 mod = None
                 for c in range(n_color_classes):
@@ -500,7 +679,8 @@ class PhaseRunner:
                         mask = mask & active
                     work = jnp.where(mask, tgt_c, work)
                 target = work
-            if et_mode and color_classes is None:
+            if et_mode and color_classes is None \
+                    and self._class_plans is None:
                 target = jnp.where(active, target, comm)
             curr_mod = float(mod)
             if et_stop:
@@ -627,11 +807,14 @@ def louvain_phases(
 
     ``coloring=N`` (reference -c N): distance-1 color the phase-0 graph with
     N/2 hash functions and run the per-color sub-sweep schedule
-    (main.cpp:243-283).  ``vertex_ordering=N`` (reference -d N): compute the
-    same coloring but use it only to order the sequential sweep
-    (louvain.cpp:1535-1562) — under this framework's synchronous-step
-    semantics ordering has no effect, so it runs the plain schedule; the
-    coloring is still computed and reported for parity."""
+    (main.cpp:243-283); on the single-shard bucketed engine each class
+    sweeps ONLY its own bucket plan, so an iteration costs ~one sweep
+    total.  ``vertex_ordering=N`` (reference -d N): the same per-class
+    sequential commits, but with community degree/size tables FROZEN at the
+    iteration start — colors only order the sweep, exchanges hoisted out of
+    the color loop (louvain.cpp:1535-1562).  Ordering is implemented on the
+    single-shard bucketed engine; other engines fall back to the plain
+    schedule."""
     if mesh is None and nshards > 1:
         mesh = make_mesh(nshards)
     if engine == "auto":
@@ -734,21 +917,23 @@ def louvain_phases(
             if verbose:
                 print(f"Number of colors (2*nHash rounds): {n_colors}, "
                       f"colored {int((colors >= 0).sum())}/{g.num_vertices}")
-            if coloring:
-                # Compress to dense class ids (order preserved); uncolored
-                # vertices form the last class (the reference passes
-                # numColors+1 classes, main.cpp:259).
-                used = np.unique(colors[colors >= 0])
-                remap = np.zeros(max(int(used.max()) + 1, 1), dtype=np.int64)
-                remap[used] = np.arange(len(used))
-                dense = np.where(colors >= 0, remap[np.maximum(colors, 0)],
-                                 len(used))
-                n_classes = len(used) + 1
-                cpad = np.full(dg.total_padded_vertices, n_classes - 1,
+            # Compress to dense class ids (order preserved); uncolored
+            # vertices form the last class (the reference passes
+            # numColors+1 classes, main.cpp:259).
+            used = np.unique(colors[colors >= 0])
+            remap = np.zeros(max(int(used.max()) + 1, 1), dtype=np.int64)
+            remap[used] = np.arange(len(used))
+            dense = np.where(colors >= 0, remap[np.maximum(colors, 0)],
+                             len(used))
+            n_classes = len(used) + 1
+            color_np = np.full(dg.total_padded_vertices, n_classes - 1,
                                dtype=np.int32)
-                cpad[dg.old_to_pad] = dense
-                color_dev = (shard_1d(mesh, cpad) if mesh is not None
-                             else jnp.asarray(cpad))
+            color_np[dg.old_to_pad] = dense
+            if coloring:
+                color_dev = (shard_1d(mesh, color_np) if mesh is not None
+                             else jnp.asarray(color_np))
+        else:
+            color_np = None
 
         runner = None
 
@@ -762,8 +947,13 @@ def louvain_phases(
             while True:
                 if runner is None:
                     with tracer.stage("plan"):
-                        runner = PhaseRunner(dg, mesh=mesh, engine=engine,
-                                             budget=budget, exchange=exchange)
+                        runner = PhaseRunner(
+                            dg, mesh=mesh, engine=engine,
+                            budget=budget, exchange=exchange,
+                            color_local=color_np,
+                            n_color_classes=n_classes,
+                            ordering=bool(vertex_ordering and not coloring),
+                        )
                 with tracer.stage("iterate"):
                     cp, cm, it, ovf = runner.run(run_threshold, **run_kw)
                 if not ovf:

@@ -1,0 +1,145 @@
+"""Scheduling variants: class-restricted coloring sweeps, vertex-ordering
+(frozen community info), and the on-device ET loop."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cuvite_tpu.core.distgraph import DistGraph
+from cuvite_tpu.evaluate.modularity import modularity as mod_oracle
+from cuvite_tpu.io.generate import generate_rgg, generate_rmat
+from cuvite_tpu.louvain.driver import PhaseRunner, louvain_phases
+
+
+def test_class_restricted_sweep_matches_full_sweep_masked():
+    """A class's restricted-plan step must decide exactly what the full
+    sweep decides for that class's vertices (same state, same formulas) —
+    the optimization changes cost, not semantics."""
+    from cuvite_tpu.louvain.bucketed import BucketPlan
+    from cuvite_tpu.louvain.driver import _bucketed_class_jit, _bucketed_jit
+
+    g = generate_rmat(9, edge_factor=8, seed=2)
+    dg = DistGraph.build(g, 1)
+    sh = dg.shards[0]
+    nvp = dg.nv_pad
+    nvt = dg.total_padded_vertices
+    vdt, wdt = np.int32, np.float32
+    sentinel = np.iinfo(vdt).max
+    rng = np.random.default_rng(0)
+    cls = rng.integers(0, 4, nvp).astype(np.int32)
+
+    src_np = np.asarray(sh.src)
+    full_plan = BucketPlan.build(src_np, np.asarray(sh.dst),
+                                 np.asarray(sh.w), nv_local=nvp, base=0)
+
+    def upload(plan):
+        bk = tuple((jnp.asarray(b.verts.astype(vdt)),
+                    jnp.asarray(b.dst.astype(vdt)),
+                    jnp.asarray(b.w.astype(wdt))) for b in plan.buckets)
+        hv = (jnp.asarray(plan.heavy_src.astype(vdt)),
+              jnp.asarray(plan.heavy_dst.astype(vdt)),
+              jnp.asarray(plan.heavy_w.astype(wdt)))
+        return bk, hv, jnp.asarray(plan.self_loop.astype(wdt))
+
+    fb, fh, fs = upload(full_plan)
+    comm = jnp.arange(nvt, dtype=vdt)
+    vdeg = jnp.asarray(dg.padded_weighted_degrees().astype(wdt))
+    const = jnp.asarray(1.0 / g.total_edge_weight_twice(), dtype=wdt)
+
+    # advance two plain iterations for a non-trivial state
+    for _ in range(2):
+        comm = _bucketed_jit(fb, fh, fs, comm, vdeg, const, nv_total=nvt,
+                             sentinel=sentinel, accum_dtype="float32")[0]
+
+    full_tgt = _bucketed_jit(fb, fh, fs, comm, vdeg, const, nv_total=nvt,
+                             sentinel=sentinel, accum_dtype="float32")[0]
+    for c in range(4):
+        src_c = np.where(
+            (src_np < nvp) & (cls[np.minimum(src_np, nvp - 1)] == c),
+            src_np, nvp).astype(src_np.dtype)
+        pc = BucketPlan.build(src_c, np.asarray(sh.dst), np.asarray(sh.w),
+                              nv_local=nvp, base=0)
+        cb, ch, cs = upload(pc)
+        tgt_c = _bucketed_class_jit(cb, ch, cs, comm, comm, vdeg, const,
+                                    nv_total=nvt, sentinel=sentinel,
+                                    accum_dtype="float32")[0]
+        in_c = cls == c
+        np.testing.assert_array_equal(
+            np.asarray(tgt_c)[in_c], np.asarray(full_tgt)[in_c],
+            err_msg=f"class {c} decisions differ from full sweep")
+        # vertices outside the class never move in the class step
+        np.testing.assert_array_equal(
+            np.asarray(tgt_c)[~in_c], np.asarray(comm)[~in_c])
+
+
+def test_vertex_ordering_uses_frozen_info():
+    """info_comm must change decisions once comm has drifted from the
+    snapshot — the mechanism that makes -d a real variant."""
+    from cuvite_tpu.louvain.bucketed import BucketPlan
+    from cuvite_tpu.louvain.driver import _bucketed_class_jit, _bucketed_jit
+
+    g = generate_rmat(9, edge_factor=8, seed=2)
+    dg = DistGraph.build(g, 1)
+    sh = dg.shards[0]
+    nvt = dg.total_padded_vertices
+    vdt, wdt = np.int32, np.float32
+    sentinel = np.iinfo(vdt).max
+    plan = BucketPlan.build(np.asarray(sh.src), np.asarray(sh.dst),
+                            np.asarray(sh.w), nv_local=dg.nv_pad, base=0)
+    bk = tuple((jnp.asarray(b.verts.astype(vdt)),
+                jnp.asarray(b.dst.astype(vdt)),
+                jnp.asarray(b.w.astype(wdt))) for b in plan.buckets)
+    hv = (jnp.asarray(plan.heavy_src.astype(vdt)),
+          jnp.asarray(plan.heavy_dst.astype(vdt)),
+          jnp.asarray(plan.heavy_w.astype(wdt)))
+    sl = jnp.asarray(plan.self_loop.astype(wdt))
+    comm0 = jnp.arange(nvt, dtype=vdt)
+    vdeg = jnp.asarray(dg.padded_weighted_degrees().astype(wdt))
+    const = jnp.asarray(1.0 / g.total_edge_weight_twice(), dtype=wdt)
+
+    comm1 = _bucketed_jit(bk, hv, sl, comm0, vdeg, const, nv_total=nvt,
+                          sentinel=sentinel, accum_dtype="float32")[0]
+    assert not np.array_equal(np.asarray(comm0), np.asarray(comm1))
+    fresh = _bucketed_class_jit(bk, hv, sl, comm1, comm1, vdeg, const,
+                                nv_total=nvt, sentinel=sentinel,
+                                accum_dtype="float32")[0]
+    frozen = _bucketed_class_jit(bk, hv, sl, comm1, comm0, vdeg, const,
+                                 nv_total=nvt, sentinel=sentinel,
+                                 accum_dtype="float32")[0]
+    assert not np.array_equal(np.asarray(fresh), np.asarray(frozen)), \
+        "frozen community info produced identical decisions (no-op -d)"
+
+
+def test_vertex_ordering_end_to_end_quality_and_difference():
+    g = generate_rgg(512, seed=9)
+    r_plain = louvain_phases(g)
+    r_order = louvain_phases(g, vertex_ordering=8)
+    q_plain = mod_oracle(g, r_plain.communities)
+    q_order = mod_oracle(g, r_order.communities)
+    assert q_order >= 0.8 * q_plain
+    # -d must actually change the run (iteration trajectory or result)
+    traj_plain = [(p.iterations, p.num_vertices) for p in r_plain.phases]
+    traj_order = [(p.iterations, p.num_vertices) for p in r_order.phases]
+    assert (traj_plain != traj_order
+            or not np.array_equal(r_plain.communities, r_order.communities))
+
+
+@pytest.mark.parametrize("et_mode", [1, 2, 3, 4])
+def test_et_device_loop_converges(karate, et_mode):
+    res = louvain_phases(karate, et_mode=et_mode, et_delta=0.25)
+    q = mod_oracle(karate, res.communities)
+    assert q >= 0.3
+    assert res.modularity == pytest.approx(q, abs=1e-6)
+
+
+def test_et_freeze_reduces_or_keeps_work():
+    g = generate_rgg(512, seed=9)
+    r0 = louvain_phases(g)
+    r3 = louvain_phases(g, et_mode=3)
+    assert mod_oracle(g, r3.communities) >= 0.8 * mod_oracle(g, r0.communities)
+
+
+def test_coloring_multishard_still_works(karate):
+    res = louvain_phases(karate, nshards=4, coloring=8)
+    assert mod_oracle(karate, res.communities) >= 0.38
