@@ -54,8 +54,14 @@ def _init_backend(max_tries: int = 2, timeout_s: int = 90) -> str:
 
     import jax
 
-    probe = ("import jax; d = jax.devices(); "
-             "print(d[0].platform, len(d))")
+    # The probe must report the backend's REGISTRY name (e.g. 'axon' for
+    # the TPU tunnel plugin), not Device.platform (which says 'tpu'):
+    # jax_platforms is matched against registry names, and pinning 'tpu'
+    # would select the built-in libtpu plugin that has no device here.
+    probe = ("import jax; from jax._src import xla_bridge as xb; "
+             "d = jax.devices(); "
+             "n = [k for k, b in xb.backends().items() if b is d[0].client]; "
+             "print(n[0] if n else d[0].platform, len(d))")
     for attempt in range(1, max_tries + 1):
         try:
             out = subprocess.run(

@@ -96,6 +96,14 @@ def build_parser() -> argparse.ArgumentParser:
                      help="print a stage-time breakdown, TEPS and RSS "
                           "high-water (the reference's per-stage "
                           "MPI_Wtime/getrusage instrumentation)")
+    out.add_argument("--dist-stats", action="store_true",
+                     help="print graph edge-distribution characteristics "
+                          "(the reference's PRINT_DIST_STATS block, "
+                          "distgraph.hpp:100-149)")
+    out.add_argument("--diag-prefix", metavar="PREFIX",
+                     help="write per-shard diagnostic files PREFIX.<shard> "
+                          "(the reference's dat.out.<rank> streams, "
+                          "main.cpp:101-110)")
     out.add_argument("--quiet", action="store_true")
     return p
 
@@ -174,6 +182,8 @@ def main(argv=None) -> int:
         tracer=tracer,
         checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,
+        dist_stats=args.dist_stats,
+        diag_prefix=args.diag_prefix,
     )
     if args.trace:
         print(tracer.report())

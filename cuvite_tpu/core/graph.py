@@ -95,7 +95,10 @@ class Graph:
             w = np.asarray(weights, dtype=np.float64)
         from cuvite_tpu import native
 
-        if len(src) >= native.MIN_NATIVE_EDGES and native.available():
+        # The native builder's composite radix key src*nv+dst only fits
+        # uint64 for nv <= 2^32; beyond that use the numpy path.
+        if (len(src) >= native.MIN_NATIVE_EDGES and native.available()
+                and num_vertices <= 1 << 32):
             offsets, tails, wsum = native.build_csr(
                 num_vertices, src, dst, w, symmetrize
             )

@@ -21,6 +21,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import time
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -332,6 +333,7 @@ class PhaseRunner:
         self.mesh = mesh
         self.engine = engine
         self.budget = None
+        self.ghost_counts = None    # per-shard ghost counts (sparse plan)
         self._class_plans = None    # per-color-class bucket plans
         self._mod_args = None       # full-plan args for _bucketed_mod_jit
         self.ordering = bool(ordering)
@@ -345,7 +347,11 @@ class PhaseRunner:
         multi = mesh is not None and int(np.prod(mesh.devices.shape)) > 1
         if engine == "pallas" and multi:
             # The Pallas upload layout is single-shard for now; the SPMD
-            # path keeps the XLA bucketed step.
+            # path keeps the XLA bucketed step.  Warn so a benchmark of
+            # --engine pallas on a mesh is not misattributed.
+            warnings.warn(
+                "engine='pallas' is single-shard only; running the "
+                "'bucketed' engine on this mesh instead", stacklevel=2)
             engine = "bucketed"
         if engine == "bucketed" and multi:
             # SPMD bucketed path: per-shard plans padded to common shapes,
@@ -360,6 +366,7 @@ class PhaseRunner:
                 from cuvite_tpu.comm.exchange import ExchangePlan
 
                 xplan = ExchangePlan.build(dg)
+                self.ghost_counts = [len(g) for g in xplan.ghost_ids]
                 if budget is None:
                     budget = max(128, dg.nv_pad // 4)
                 budget = min(int(budget), dg.nv_pad)
@@ -799,6 +806,8 @@ def louvain_phases(
     tracer=None,
     checkpoint_dir: str | None = None,
     resume: bool = False,
+    dist_stats: bool = False,
+    diag_prefix: str | None = None,
 ) -> LouvainResult:
     """Full multi-phase Louvain (the main.cpp:218-495 loop).
 
@@ -825,7 +834,13 @@ def louvain_phases(
     ):
         # The fused program covers the default single-shard schedule; the
         # per-phase drivers own the ET/coloring variants, SPMD, and
-        # checkpointing (which needs phase boundaries on the host).
+        # checkpointing (which needs phase boundaries on the host).  Warn so
+        # a benchmark of --engine fused on those configs is not
+        # misattributed to the fused program.
+        warnings.warn(
+            "engine='fused' covers only the plain single-shard schedule; "
+            "running the 'bucketed' engine for this configuration instead",
+            stacklevel=2)
         engine = "bucketed"
 
     nv0 = graph.num_vertices
@@ -860,14 +875,29 @@ def louvain_phases(
     t_start = time.perf_counter()
     phase = 0
     g = graph
+    if diag_prefix:
+        from cuvite_tpu.utils.trace import ShardDiag
+
+        diag = ShardDiag(diag_prefix, nshards)
+    else:
+        diag = None
     # Sparse-exchange per-peer budget, sticky across phases (grows on
     # overflow retry; None = PhaseRunner's default of max(128, nv_pad/4)).
     budget = exchange_budget
 
     if resume and checkpoint_dir:
-        from cuvite_tpu.utils.checkpoint import load_latest
+        from cuvite_tpu.utils.checkpoint import graph_fingerprint, load_latest
 
         ck = load_latest(checkpoint_dir)
+        if ck is not None and ck.fingerprint != -1 \
+                and ck.fingerprint != graph_fingerprint(graph):
+            # Same directory, different graph content (e.g. same-scale R-MAT
+            # with another seed): composing its labels would be silently
+            # wrong, and silently restarting would hide the mistake.
+            raise ValueError(
+                f"checkpoint in {checkpoint_dir!r} was written for a "
+                "different graph (content fingerprint mismatch); use a "
+                "fresh --checkpoint-dir or drop --resume")
         if ck is not None and len(ck.comm_all) == nv0 \
                 and ck.orig_ne == graph.num_edges:
             g = ck.graph
@@ -979,6 +1009,19 @@ def louvain_phases(
         t2 = time.perf_counter()
         tot_iters += iters
         tracer.count("traversed_edges", g.num_edges * iters)
+        if dist_stats and phase == 0:
+            from cuvite_tpu.utils.trace import dist_stats_report
+
+            print(dist_stats_report(
+                dg, getattr(runner, "ghost_counts", None)))
+        if diag:
+            gc = getattr(runner, "ghost_counts", None)
+            for s, sh in enumerate(dg.shards):
+                diag.write(s, f"phase {phase}: owned="
+                           f"{sh.bound - sh.base} edges={sh.n_real_edges}"
+                           f"{f' ghosts={gc[s]}' if gc else ''}"
+                           f" iters={iters} Q={curr_mod:.6f}"
+                           f" t={t2 - t1:.3f}s")
 
         # Map padded-space communities back to original-id labels for the
         # real vertices of this phase's graph.
@@ -1006,7 +1049,7 @@ def louvain_phases(
             phase += 1
             if checkpoint_dir:
                 from cuvite_tpu.utils.checkpoint import (
-                    PhaseCheckpoint, save_phase,
+                    PhaseCheckpoint, graph_fingerprint, save_phase,
                 )
 
                 save_phase(checkpoint_dir, PhaseCheckpoint(
@@ -1017,6 +1060,7 @@ def louvain_phases(
                     nv_hist=np.array([p.num_vertices for p in phases]),
                     ne_hist=np.array([p.num_edges for p in phases]),
                     orig_ne=graph.num_edges,
+                    fingerprint=graph_fingerprint(graph),
                 ))
         else:
             # Safety net: when cycling exits early, run one final 1e-6 pass
@@ -1043,6 +1087,8 @@ def louvain_phases(
                     ))
             break
 
+    if diag:
+        diag.close()
     # Final contiguous renumber of the composed labels (main.cpp:374-394).
     dense_all, _ = renumber_communities(comm_all)
     return LouvainResult(

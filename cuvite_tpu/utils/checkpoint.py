@@ -36,7 +36,27 @@ class PhaseCheckpoint:
     iter_hist: np.ndarray
     nv_hist: np.ndarray      # vertices/edges of each completed phase's graph
     ne_hist: np.ndarray
-    orig_ne: int = -1        # edge count of the ORIGINAL graph (fingerprint)
+    orig_ne: int = -1        # edge count of the ORIGINAL graph
+    fingerprint: int = -1    # content fingerprint of the ORIGINAL graph
+
+
+def graph_fingerprint(graph: Graph) -> int:
+    """Cheap content fingerprint: CRC of the CSR offsets plus the total edge
+    weight.  Distinguishes graphs that share (nv, ne) — e.g. same-scale
+    R-MATs with different seeds — so a resume in a reused checkpoint
+    directory cannot silently compose labels for the wrong graph."""
+    import zlib
+
+    h = zlib.crc32(np.ascontiguousarray(graph.offsets).view(np.uint8))
+    tw = float(np.sum(graph.weights, dtype=np.float64))
+    h = zlib.crc32(np.float64(tw).tobytes(), h)
+    return (h << 16) ^ (graph.num_vertices & 0xFFFF)
+
+
+def _phase_num(name: str) -> int | None:
+    """Parse N from 'phase_<N>.npz' (any digit count; None if malformed)."""
+    stem = name[len("phase_"):-len(".npz")]
+    return int(stem) if stem.isdigit() else None
 
 
 def _path(ckpt_dir: str, phase: int) -> str:
@@ -66,6 +86,7 @@ def save_phase(ckpt_dir: str, ck: PhaseCheckpoint) -> str:
             nv_hist=np.asarray(ck.nv_hist, dtype=np.int64),
             ne_hist=np.asarray(ck.ne_hist, dtype=np.int64),
             orig_ne=np.int64(ck.orig_ne),
+            fingerprint=np.int64(ck.fingerprint),
         )
     os.replace(tmp, path)
     # Runs advance monotonically, so any higher-numbered file is leftover
@@ -73,11 +94,8 @@ def save_phase(ckpt_dir: str, ck: PhaseCheckpoint) -> str:
     # --resume would pick the stale run's final phase over this one.
     for name in os.listdir(ckpt_dir):
         if name.startswith("phase_") and name.endswith(".npz"):
-            try:
-                num = int(name[6:10])
-            except ValueError:
-                continue
-            if num > ck.phase:
+            num = _phase_num(name)
+            if num is not None and num > ck.phase:
                 os.remove(os.path.join(ckpt_dir, name))
     return path
 
@@ -86,8 +104,10 @@ def load_latest(ckpt_dir: str) -> PhaseCheckpoint | None:
     if not os.path.isdir(ckpt_dir):
         return None
     names = sorted(
-        n for n in os.listdir(ckpt_dir)
-        if n.startswith("phase_") and n.endswith(".npz")
+        (n for n in os.listdir(ckpt_dir)
+         if n.startswith("phase_") and n.endswith(".npz")
+         and _phase_num(n) is not None),
+        key=_phase_num,
     )
     for name in reversed(names):
         path = os.path.join(ckpt_dir, name)
@@ -113,6 +133,8 @@ def load_latest(ckpt_dir: str) -> PhaseCheckpoint | None:
                     nv_hist=np.asarray(z["nv_hist"]),
                     ne_hist=np.asarray(z["ne_hist"]),
                     orig_ne=int(z["orig_ne"]),
+                    fingerprint=(int(z["fingerprint"])
+                                 if "fingerprint" in z else -1),
                 )
         except (OSError, ValueError, KeyError, zipfile.BadZipFile):
             continue  # truncated/corrupt file: fall back to the previous one

@@ -16,6 +16,8 @@ only boundaries that exist under jit), RSS high-water, TEPS accounting
 from __future__ import annotations
 
 import contextlib
+import math
+import os
 import resource
 import time
 
@@ -88,6 +90,74 @@ class Tracer:
             )
         lines.append(f"  rss high-water   {rss_high_water_mb():.0f} MiB")
         return "\n".join(lines)
+
+
+def dist_stats_report(dg, ghost_counts=None) -> str:
+    """Edge-distribution characteristics of a DistGraph partition: the
+    analog of the reference's PRINT_DIST_STATS block
+    (/root/reference/distgraph.hpp:100-149), which Allreduces per-rank
+    local edge counts and prints min/max/mean/variance/stddev on rank 0.
+    Here the partition is host-resident, so the moments are computed
+    directly; ghost counts (from the phase ExchangePlan) are appended when
+    available — the piece the reference's stats lack."""
+    counts = [sh.n_real_edges for sh in dg.shards]
+    n = max(len(counts), 1)
+    mean = sum(counts) / n
+    avg_sq = sum(c * c for c in counts) / n
+    var = abs(avg_sq - mean * mean)
+    lines = [
+        "-" * 55,
+        "Graph edge distribution characteristics",
+        "-" * 55,
+        f"Number of vertices: {dg.total_vertices}",
+        f"Number of edges: {dg.total_edges}",
+        f"Number of shards: {dg.nshards}",
+        f"Maximum number of edges: {max(counts)}",
+        f"Minimum number of edges: {min(counts)}",
+        f"Mean number of edges: {mean:g}",
+        f"Variance: {var:g}",
+        f"Standard deviation: {math.sqrt(var):g}",
+    ]
+    if ghost_counts is not None:
+        lines.append(
+            f"Ghost vertices per shard: max {max(ghost_counts)}, "
+            f"min {min(ghost_counts)}, "
+            f"mean {sum(ghost_counts) / max(len(ghost_counts), 1):g}")
+    lines.append("-" * 55)
+    return "\n".join(lines)
+
+
+class ShardDiag:
+    """Per-shard diagnostic text files: the analog of the reference's
+    per-rank `dat.out.<rank>` streams (/root/reference/main.cpp:101-110).
+    One `<prefix>.<shard>` file per shard, appended a line per call; files
+    open lazily on first write."""
+
+    def __init__(self, prefix: str, nshards: int):
+        self.prefix = prefix
+        self.nshards = nshards
+        self._files: dict[int, object] = {}
+
+    def write(self, shard: int, line: str) -> None:
+        f = self._files.get(shard)
+        if f is None:
+            d = os.path.dirname(self.prefix)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            f = open(f"{self.prefix}.{shard}", "a")
+            self._files[shard] = f
+        f.write(line.rstrip("\n") + "\n")
+
+    def close(self) -> None:
+        for f in self._files.values():
+            f.close()
+        self._files.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
 
 class NullTracer(Tracer):

@@ -16,12 +16,17 @@
 //    determinism of float accumulation order matters.
 //  * C ABI only — bound from Python via ctypes, no pybind11.
 
+#ifndef _FILE_OFFSET_BITS
+#define _FILE_OFFSET_BITS 64  // 64-bit off_t for fseeko on 32-bit-long ABIs
+#endif
+
 #include <algorithm>
 #include <atomic>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
 #include <numeric>
+#include <sys/types.h>
 #include <vector>
 
 #if defined(_OPENMP)
@@ -47,6 +52,8 @@ int64_t cv_build_csr(int64_t nv, int64_t ne, const int64_t* src,
                      const int64_t* dst, const double* w, int symmetrize,
                      int64_t* offsets_out, int64_t* tails_out,
                      double* weights_out) {
+  // The composite radix key src*nv+dst must fit uint64.
+  if (nv < 0 || (uint64_t)nv > (1ull << 32)) return -1;
   for (int64_t j = 0; j < ne; ++j) {
     if (src[j] < 0 || src[j] >= nv || dst[j] < 0 || dst[j] >= nv) return -1;
   }
@@ -92,10 +99,13 @@ int64_t cv_build_csr(int64_t nv, int64_t ne, const int64_t* src,
   xs.clear(); xs.shrink_to_fit();
   xd.clear(); xd.shrink_to_fit();
   xw.clear(); xw.shrink_to_fit();
+  // Max key is nv*nv-1 < 2^(2*ceil(log2 nv)); computing the bound from
+  // bits(nv-1) avoids evaluating unv*unv, which wraps at nv == 2^32.
   int key_bits = 0;
   {
-    uint64_t maxkey = unv * unv - 1;
-    while (maxkey) { ++key_bits; maxkey >>= 1; }
+    int vb = 0;
+    for (uint64_t x = unv > 0 ? unv - 1 : 0; x; x >>= 1) ++vb;
+    key_bits = 2 * vb;
   }
   for (int shift = 0; shift < key_bits; shift += 8) {
     int64_t hist[257] = {0};
@@ -223,31 +233,39 @@ int cv_vite_edges(const char* path, int bits64, int64_t nv, int64_t e0,
   const int64_t esz = bits64 ? 8 : 4;
   const int64_t rec = bits64 ? 16 : 8;
   const int64_t base = 2 * esz + (nv + 1) * esz + e0 * rec;
-  if (std::fseek(f, (long)base, SEEK_SET) != 0) { std::fclose(f); return -3; }
-  int64_t n = e1 - e0;
-  std::vector<char> buf(n * rec);
-  if ((int64_t)std::fread(buf.data(), rec, n, f) != n) {
-    std::fclose(f);
-    return -2;
+  // fseeko takes off_t (64-bit with _FILE_OFFSET_BITS=64), so offsets past
+  // 2 GiB work even where long is 32-bit; the read streams in bounded
+  // chunks so a billion-edge shard never needs a matching heap buffer.
+  if (fseeko(f, (off_t)base, SEEK_SET) != 0) { std::fclose(f); return -3; }
+  const int64_t n = e1 - e0;
+  const int64_t chunk = 4 << 20;  // records per read (<= 64 MiB buffer)
+  std::vector<char> buf((size_t)(n < chunk ? (n > 0 ? n : 1) : chunk) * rec);
+  for (int64_t done = 0; done < n; ) {
+    const int64_t c = n - done < chunk ? n - done : chunk;
+    if ((int64_t)std::fread(buf.data(), rec, c, f) != c) {
+      std::fclose(f);
+      return -2;
+    }
+    if (bits64) {
+      struct E { int64_t t; double w; };
+      const E* e = (const E*)buf.data();
+#pragma omp parallel for schedule(static)
+      for (int64_t i = 0; i < c; ++i) {
+        tails_out[done + i] = e[i].t;
+        weights_out[done + i] = e[i].w;
+      }
+    } else {
+      struct E { int32_t t; float w; };
+      const E* e = (const E*)buf.data();
+#pragma omp parallel for schedule(static)
+      for (int64_t i = 0; i < c; ++i) {
+        tails_out[done + i] = e[i].t;
+        weights_out[done + i] = e[i].w;
+      }
+    }
+    done += c;
   }
   std::fclose(f);
-  if (bits64) {
-    struct E { int64_t t; double w; };
-    const E* e = (const E*)buf.data();
-#pragma omp parallel for schedule(static)
-    for (int64_t i = 0; i < n; ++i) {
-      tails_out[i] = e[i].t;
-      weights_out[i] = e[i].w;
-    }
-  } else {
-    struct E { int32_t t; float w; };
-    const E* e = (const E*)buf.data();
-#pragma omp parallel for schedule(static)
-    for (int64_t i = 0; i < n; ++i) {
-      tails_out[i] = e[i].t;
-      weights_out[i] = e[i].w;
-    }
-  }
   return 0;
 }
 

@@ -2,6 +2,7 @@
 the same final clustering as an uninterrupted run."""
 
 import numpy as np
+import pytest
 
 from cuvite_tpu.louvain.driver import louvain_phases
 from cuvite_tpu.utils.checkpoint import load_latest
@@ -32,15 +33,27 @@ def test_resume_without_checkpoint_is_fresh(karate, tmp_path):
     assert np.array_equal(res.communities, full.communities)
 
 
-def test_checkpoint_mismatched_graph_ignored(karate, ring8, tmp_path):
-    """A checkpoint for a different graph (vertex-count mismatch) must not
-    be loaded."""
+def test_checkpoint_mismatched_graph_raises(karate, ring8, tmp_path):
+    """Resuming in a directory written for a DIFFERENT graph must surface
+    the mismatch (content fingerprint), not silently compose wrong labels
+    or silently restart."""
     ckpt = str(tmp_path / "ck")
     louvain_phases(karate, checkpoint_dir=ckpt, max_phases=1)
-    res = louvain_phases(ring8, checkpoint_dir=str(tmp_path / "ck"),
-                         resume=True)
-    fresh = louvain_phases(ring8)
-    assert np.array_equal(res.communities, fresh.communities)
+    with pytest.raises(ValueError, match="fingerprint"):
+        louvain_phases(ring8, checkpoint_dir=ckpt, resume=True)
+
+
+def test_checkpoint_same_shape_different_content_raises(karate, tmp_path):
+    """Same (nv, ne) but different weights — the silent-wrong-resume case
+    the counts-only fingerprint missed — must also raise."""
+    ckpt = str(tmp_path / "ck")
+    louvain_phases(karate, checkpoint_dir=ckpt, max_phases=1)
+    from cuvite_tpu.core.graph import Graph
+
+    other = Graph(offsets=karate.offsets.copy(), tails=karate.tails.copy(),
+                  weights=karate.weights * 2.0, policy=karate.policy)
+    with pytest.raises(ValueError, match="fingerprint"):
+        louvain_phases(other, checkpoint_dir=ckpt, resume=True)
 
 
 def test_corrupt_checkpoint_falls_back(karate, tmp_path):

@@ -52,3 +52,44 @@ def test_cli_trace_flag(tmp_path, karate, capsys):
     main(["--file", p, "--bits64", "--trace", "--quiet"])
     out = capsys.readouterr().out
     assert "stage breakdown" in out and "TEPS" in out
+
+
+def test_dist_stats_report(karate):
+    from cuvite_tpu.core.distgraph import DistGraph
+    from cuvite_tpu.utils.trace import dist_stats_report
+
+    dg = DistGraph.build(karate, 4)
+    rep = dist_stats_report(dg, ghost_counts=[3, 1, 2, 0])
+    assert f"Number of vertices: {karate.num_vertices}" in rep
+    assert f"Number of edges: {karate.num_edges}" in rep
+    assert "Standard deviation:" in rep
+    assert "Ghost vertices per shard: max 3" in rep
+    counts = [sh.n_real_edges for sh in dg.shards]
+    assert f"Maximum number of edges: {max(counts)}" in rep
+
+
+def test_shard_diag_files(tmp_path, karate):
+    """--diag-prefix writes one file per shard, a line per phase (the
+    reference's dat.out.<rank>, main.cpp:101-110)."""
+    prefix = str(tmp_path / "diag" / "dat.out")
+    res = louvain_phases(karate, nshards=4, diag_prefix=prefix)
+    assert res.modularity > 0.40
+    for s in range(4):
+        lines = open(f"{prefix}.{s}").read().splitlines()
+        # One line per phase ATTEMPT: the final no-gain phase writes its
+        # line too but is not appended to res.phases.
+        assert len(lines) >= len(res.phases)
+        assert lines[0].startswith("phase 0: owned=")
+        assert "ghosts=" in lines[0] and "Q=" in lines[0]
+
+
+def test_cli_dist_stats_flag(tmp_path, karate, capsys):
+    from cuvite_tpu.cli import main
+    from cuvite_tpu.io.vite import write_vite
+
+    p = str(tmp_path / "k.bin")
+    write_vite(p, karate)
+    main(["--file", p, "--bits64", "--dist-stats", "--shards", "2",
+          "--quiet"])
+    out = capsys.readouterr().out
+    assert "Graph edge distribution characteristics" in out
