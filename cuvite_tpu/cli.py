@@ -57,6 +57,23 @@ def build_parser() -> argparse.ArgumentParser:
     src.add_argument("--write-graph", "-s", metavar="FILE",
                      help="write the generated graph in Vite binary format")
 
+    dist = p.add_argument_group("distributed (multi-host)")
+    dist.add_argument("--distributed", action="store_true",
+                      help="connect this process to a multi-host run via "
+                           "jax.distributed.initialize (MPI_Init analog, "
+                           "main.cpp:67-70); every host runs the same "
+                           "command")
+    dist.add_argument("--coordinator", metavar="HOST:PORT",
+                      help="coordinator address (default: "
+                           "$CUVITE_COORDINATOR, else auto-detect on "
+                           "Cloud TPU)")
+    dist.add_argument("--num-processes", type=int,
+                      help="total process count (default: "
+                           "$CUVITE_NUM_PROCESSES or auto)")
+    dist.add_argument("--process-id", type=int,
+                      help="this process's rank (default: "
+                           "$CUVITE_PROCESS_ID or auto)")
+
     run = p.add_argument_group("clustering")
     run.add_argument("--shards", type=int, default=1,
                      help="number of mesh devices (vertex shards)")
@@ -128,6 +145,31 @@ def validate(args) -> None:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     validate(args)
+
+    if args.distributed:
+        # Before any jax backend touch: after this, jax.devices() is the
+        # GLOBAL device list across all hosts and --shards may span it.
+        from cuvite_tpu.comm.multihost import initialize
+
+        initialize(coordinator=args.coordinator,
+                   num_processes=args.num_processes,
+                   process_id=args.process_id)
+        import jax
+
+        if jax.process_index() != 0:
+            # Output and chatter are rank-0's job (the reference gates its
+            # output/report paths on me == 0, main.cpp:363-406, :521-559);
+            # every process still computes the identical result.  File
+            # writers must also be gated or hosts sharing a filesystem
+            # would write the same paths concurrently.
+            args.quiet = True
+            args.output = False
+            args.json = False
+            args.ground_truth = None
+            args.trace = False
+            args.dist_stats = False
+            args.diag_prefix = None
+            args.write_graph = None
 
     from cuvite_tpu.core.graph import Graph  # noqa: F401 (re-export context)
     from cuvite_tpu.evaluate.compare import (

@@ -28,11 +28,24 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
                     f"--xla_force_host_platform_device_count={n_devices} "
                     f"before jax initializes"
                 )
+            if jax.process_count() > 1 and n_devices != len(devices):
+                # Slicing jax.devices()[:n] would keep only the lowest
+                # ranks' devices, leaving other processes with no
+                # addressable mesh entry — a deadlock, not a smaller run.
+                raise ValueError(
+                    f"a multi-process mesh must span all "
+                    f"{len(devices)} global devices; got n_devices="
+                    f"{n_devices} (launch fewer processes/devices instead)"
+                )
             devices = devices[:n_devices]
     return Mesh(np.array(devices), (VERTEX_AXIS,))
 
 
 def shard_1d(mesh: Mesh, arr, replicate: bool = False):
-    """Place an array on the mesh, sharded along axis 0 (or replicated)."""
+    """Place an array on the mesh, sharded along axis 0 (or replicated).
+    Works on single-process and multi-host meshes alike (the latter via
+    per-process local blocks, comm/multihost.py)."""
+    from cuvite_tpu.comm.multihost import place
+
     spec = P() if replicate else P(VERTEX_AXIS)
-    return jax.device_put(arr, NamedSharding(mesh, spec))
+    return place(mesh, arr, spec)

@@ -1,0 +1,123 @@
+"""Multi-host bootstrap and host-level collectives.
+
+The reference bootstraps with MPI_Init + per-rank collective MPI-IO ingest
+(/root/reference/main.cpp:67-70, distgraph.cpp:69-203) and binds GPUs via a
+shared-memory sub-communicator (louvain_cuda.cu:1634-1669).  The TPU-native
+analog: `jax.distributed.initialize` connects the processes of a multi-host
+run (one process per host, e.g. 8 hosts x 8 chips on a v5p-64), after which
+`jax.devices()` is the GLOBAL device list and a 1-D mesh over it spans the
+pod slice.  Collectives then ride ICI within a host's chips and DCN across
+hosts — XLA schedules them from the sharding, no transport code here.
+
+Launch recipe (every host runs the same command):
+
+    CUVITE_COORDINATOR=<host0-ip>:8476 \
+    CUVITE_NUM_PROCESSES=8 CUVITE_PROCESS_ID=<0..7> \
+    python -m cuvite_tpu.cli --file big.bin --shards 64 --distributed ...
+
+On Cloud TPU the three env vars can be omitted entirely:
+`jax.distributed.initialize()` auto-discovers the slice topology from the
+TPU metadata server.
+
+Design note: host-side planning (partitioning, bucket plans, ghost routing,
+coarsening) is REPLICATED — every process computes the identical plan
+deterministically from the same graph metadata, the way every MPI rank holds
+the same `parts[]` table.  Device state is what is sharded.  Per-host ingest
+can still read only the edge ranges this host's shards own
+(`read_vite(vertex_range=...)`); the remaining host arrays are O(nv), not
+O(ne).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+
+_INITIALIZED = False
+
+
+def initialize(coordinator: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None,
+               local_device_ids=None) -> None:
+    """Connect this process to a multi-host run (MPI_Init analog).
+
+    Arguments fall back to CUVITE_COORDINATOR / CUVITE_NUM_PROCESSES /
+    CUVITE_PROCESS_ID, then to JAX's own auto-detection (which knows Cloud
+    TPU, SLURM and OpenMPI environments).  Must run before the first
+    device/backend touch.  Safe to call once per process.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    coordinator = coordinator or os.environ.get("CUVITE_COORDINATOR")
+    if num_processes is None and os.environ.get("CUVITE_NUM_PROCESSES"):
+        num_processes = int(os.environ["CUVITE_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("CUVITE_PROCESS_ID"):
+        process_id = int(os.environ["CUVITE_PROCESS_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    _INITIALIZED = True
+
+
+def is_distributed() -> bool:
+    return jax.process_count() > 1
+
+
+def local_shard_range(nshards: int) -> tuple[int, int]:
+    """Contiguous [lo, hi) range of shard indices owned by this process when
+    ``nshards`` vertex shards are laid over the global device list (device
+    order groups each process's devices contiguously)."""
+    per = nshards // jax.process_count()
+    rem = nshards % jax.process_count()
+    p = jax.process_index()
+    lo = p * per + min(p, rem)
+    return lo, lo + per + (1 if p < rem else 0)
+
+
+def place(mesh, arr, spec):
+    """Create a GLOBAL array on ``mesh`` with PartitionSpec ``spec`` from a
+    host array that every process holds in full.
+
+    Single-process: plain `jax.device_put`.  Multi-process: each process
+    contributes only its addressable block via
+    `jax.make_array_from_process_local_data` — the multi-host form of the
+    same placement (device_put cannot target non-addressable devices).
+    """
+    from jax.sharding import NamedSharding
+
+    sh = NamedSharding(mesh, spec)
+    if not is_distributed():
+        return jax.device_put(arr, sh)
+    arr = np.asarray(arr)
+    idx_map = sh.addressable_devices_indices_map(arr.shape)
+    if not sh.is_fully_addressable:
+        spans = [(0 if s[0].start is None else int(s[0].start),
+                  arr.shape[0] if s[0].stop is None else int(s[0].stop))
+                 for s in idx_map.values() if s]
+        if spans and len(arr.shape) >= 1:
+            lo = min(s[0] for s in spans)
+            hi = max(s[1] for s in spans)
+            if (lo, hi) != (0, arr.shape[0]):
+                # Contiguous process-local block of a 1-D sharded axis.
+                return jax.make_array_from_process_local_data(
+                    sh, np.ascontiguousarray(arr[lo:hi]), arr.shape)
+    # Replicated (or fully-local) value: local data IS the global value.
+    return jax.make_array_from_process_local_data(sh, arr, arr.shape)
+
+
+def gather_global(arr) -> np.ndarray:
+    """Fetch a (possibly multi-host sharded) global jax array to a full host
+    numpy array on EVERY process — the `MPI_Allgatherv` of the output path
+    (cf. gatherAllComm, /root/reference/louvain.cpp:3306-3347)."""
+    if not is_distributed():
+        return np.asarray(jax.device_get(arr))
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))

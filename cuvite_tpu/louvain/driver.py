@@ -29,6 +29,7 @@ import numpy as np
 
 from cuvite_tpu.coarsen.rebuild import coarsen_graph, renumber_communities
 from cuvite_tpu.comm.mesh import VERTEX_AXIS, make_mesh, shard_1d
+from cuvite_tpu.comm.multihost import gather_global
 from cuvite_tpu.core.distgraph import DistGraph
 from cuvite_tpu.core.graph import Graph
 from cuvite_tpu.core.types import (
@@ -541,7 +542,13 @@ class PhaseRunner:
             self.comm0 = jnp.asarray(comm0)
             self.real_mask_dev = jnp.asarray(self.real_mask)
         tw = dg.graph.total_edge_weight_twice()
-        self.constant = jnp.asarray(1.0 / tw, dtype=wdt)
+        if multi:
+            # Replicated GLOBAL scalar: a committed single-device array would
+            # break multi-host jit dispatch (shard_1d handles both modes).
+            self.constant = shard_1d(
+                mesh, np.asarray(1.0 / tw, dtype=wdt), replicate=True)
+        else:
+            self.constant = jnp.asarray(1.0 / tw, dtype=wdt)
         if self._bucket_extra is not None:
             b, h, sl = self._bucket_extra[:3]
             self._extra = (b, h, sl, self.vdeg, self.constant) \
@@ -597,30 +604,33 @@ class PhaseRunner:
             # Default path: the whole iteration loop runs on device with the
             # convergence check inside (one host sync per phase instead of
             # one per iteration).
-            wdt = self.constant.dtype
+            wdt = np.dtype(self.constant.dtype)
+            # Host scalars stay numpy: jit replicates them on any mesh,
+            # including multi-host ones where a committed local jnp array
+            # could not join a global computation.
             past_d, prev_mod_d, iters_d, ovf_d = _run_phase_loop(
                 self._extra, self.comm0,
-                jnp.asarray(threshold, dtype=wdt),
-                jnp.asarray(lower, dtype=wdt),
+                np.asarray(threshold, dtype=wdt),
+                np.asarray(lower, dtype=wdt),
                 call=self._call, max_iters=MAX_TOTAL_ITERATIONS,
             )
-            return (np.asarray(jax.device_get(past_d)), float(prev_mod_d),
+            return (gather_global(past_d), float(prev_mod_d),
                     int(iters_d), bool(ovf_d))
         if color_classes is None and self._class_plans is None:
             # ET modes 1-4 without coloring: freeze state lives in the
             # device loop's carry — one host sync per phase, like the
             # default path.
-            wdt = self.constant.dtype
+            wdt = np.dtype(self.constant.dtype)
             past_d, prev_mod_d, iters_d, ovf_d = _run_phase_loop_et(
                 self._extra, self.comm0,
-                jnp.asarray(threshold, dtype=wdt),
-                jnp.asarray(lower, dtype=wdt),
+                np.asarray(threshold, dtype=wdt),
+                np.asarray(lower, dtype=wdt),
                 self.real_mask_dev,
-                jnp.asarray(et_delta, dtype=wdt),
+                np.asarray(et_delta, dtype=wdt),
                 call=self._call, max_iters=MAX_TOTAL_ITERATIONS,
                 et_mode=et_mode, nv_real=int(self.real_mask.sum()),
             )
-            return (np.asarray(jax.device_get(past_d)), float(prev_mod_d),
+            return (gather_global(past_d), float(prev_mod_d),
                     int(iters_d), bool(ovf_d))
         comm = self.comm0
         past = comm
@@ -709,7 +719,7 @@ class PhaseRunner:
             comm = target
             if iters >= MAX_TOTAL_ITERATIONS:
                 break
-        return np.asarray(jax.device_get(past)), prev_mod, iters, overflow
+        return gather_global(past), prev_mod, iters, overflow
 
 
 def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
@@ -881,6 +891,7 @@ def louvain_phases(
         diag = ShardDiag(diag_prefix, nshards)
     else:
         diag = None
+    ck_fp = None  # original-graph fingerprint, computed at most once
     # Sparse-exchange per-peer budget, sticky across phases (grows on
     # overflow retry; None = PhaseRunner's default of max(128, nv_pad/4)).
     budget = exchange_budget
@@ -1009,11 +1020,12 @@ def louvain_phases(
         t2 = time.perf_counter()
         tot_iters += iters
         tracer.count("traversed_edges", g.num_edges * iters)
-        if dist_stats and phase == 0:
+        if dist_stats:
             from cuvite_tpu.utils.trace import dist_stats_report
 
             print(dist_stats_report(
                 dg, getattr(runner, "ghost_counts", None)))
+            dist_stats = False  # first executed phase only (resume-safe)
         if diag:
             gc = getattr(runner, "ghost_counts", None)
             for s, sh in enumerate(dg.shards):
@@ -1052,6 +1064,8 @@ def louvain_phases(
                     PhaseCheckpoint, graph_fingerprint, save_phase,
                 )
 
+                if ck_fp is None:  # O(ne) scan once per run, not per phase
+                    ck_fp = graph_fingerprint(graph)
                 save_phase(checkpoint_dir, PhaseCheckpoint(
                     phase=phase, comm_all=comm_all, graph=g,
                     prev_mod=prev_mod, tot_iters=tot_iters,
@@ -1060,7 +1074,7 @@ def louvain_phases(
                     nv_hist=np.array([p.num_vertices for p in phases]),
                     ne_hist=np.array([p.num_edges for p in phases]),
                     orig_ne=graph.num_edges,
-                    fingerprint=graph_fingerprint(graph),
+                    fingerprint=ck_fp,
                 ))
         else:
             # Safety net: when cycling exits early, run one final 1e-6 pass

@@ -48,6 +48,7 @@ def graph_fingerprint(graph: Graph) -> int:
     import zlib
 
     h = zlib.crc32(np.ascontiguousarray(graph.offsets).view(np.uint8))
+    h = zlib.crc32(np.ascontiguousarray(graph.tails).view(np.uint8), h)
     tw = float(np.sum(graph.weights, dtype=np.float64))
     h = zlib.crc32(np.float64(tw).tobytes(), h)
     return (h << 16) ^ (graph.num_vertices & 0xFFFF)

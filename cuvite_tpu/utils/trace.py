@@ -144,7 +144,9 @@ class ShardDiag:
             d = os.path.dirname(self.prefix)
             if d:
                 os.makedirs(d, exist_ok=True)
-            f = open(f"{self.prefix}.{shard}", "a")
+            # Truncate on first open (like the reference's per-rank
+            # ofstreams) so reruns don't mix stale lines into the files.
+            f = open(f"{self.prefix}.{shard}", "w")
             self._files[shard] = f
         f.write(line.rstrip("\n") + "\n")
 

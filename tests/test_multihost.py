@@ -1,0 +1,94 @@
+"""Multi-host launch path: 2 processes x 4 virtual CPU devices each.
+
+The TPU analog of the reference's "multi-node without a cluster" practice
+(oversubscribed MPI ranks on one node, README:48-53): two OS processes
+connect through jax.distributed.initialize over localhost, form one global
+8-device mesh, and must produce communities bit-identical to a
+single-process run of the same graph.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, sys
+proc = int(sys.argv[1]); n = int(sys.argv[2]); port = sys.argv[3]
+out_dir = sys.argv[4]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from cuvite_tpu.comm.multihost import initialize, is_distributed
+initialize(coordinator=f"127.0.0.1:{port}", num_processes=n, process_id=proc)
+assert is_distributed()
+assert len(jax.devices()) == 4 * n, jax.devices()
+
+import numpy as np
+from cuvite_tpu.core.graph import Graph
+from cuvite_tpu.comm.mesh import make_mesh
+from cuvite_tpu.louvain.driver import louvain_phases
+
+edges = np.load(os.path.join(out_dir, "edges.npy"))
+g = Graph.from_edges(int(edges.max()) + 1, edges[:, 0], edges[:, 1])
+mesh = make_mesh(4 * n)
+res = louvain_phases(g, nshards=4 * n, mesh=mesh)
+# Every process holds the full gathered labels; each writes its own copy so
+# the parent can assert cross-process agreement.
+np.save(os.path.join(out_dir, f"comm.{proc}.npy"), res.communities)
+with open(os.path.join(out_dir, f"mod.{proc}"), "w") as f:
+    f.write(repr(float(res.modularity)))
+print(f"proc {proc}: OK Q={res.modularity:.6f}")
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_run_matches_single(tmp_path):
+    from conftest import karate_edges
+
+    _, s, d = karate_edges()
+    np.save(tmp_path / "edges.npy", np.stack([s, d], axis=1))
+    (tmp_path / "worker.py").write_text(WORKER)
+    port = _free_port()
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(tmp_path / "worker.py"), str(i), "2",
+             str(port), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=420)[0] for p in procs]
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{o[-3000:]}"
+
+    c0 = np.load(tmp_path / "comm.0.npy")
+    c1 = np.load(tmp_path / "comm.1.npy")
+    assert np.array_equal(c0, c1), "processes disagree on communities"
+
+    # Single-process oracle on the same 8-device virtual mesh.
+    from cuvite_tpu.core.graph import Graph
+    from cuvite_tpu.louvain.driver import louvain_phases
+
+    edges = np.load(tmp_path / "edges.npy")
+    g = Graph.from_edges(int(edges.max()) + 1, edges[:, 0], edges[:, 1])
+    ref = louvain_phases(g, nshards=8)
+    assert np.array_equal(c0, ref.communities), \
+        "2-process run differs from single-process 8-shard run"
+    q0 = float(open(tmp_path / "mod.0").read())
+    assert abs(q0 - ref.modularity) < 1e-6
