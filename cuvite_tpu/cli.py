@@ -46,6 +46,11 @@ def build_parser() -> argparse.ArgumentParser:
     src.add_argument("--file", "-f", help="Vite binary graph file")
     src.add_argument("--bits64", action="store_true",
                      help="64-bit vertex ids / double weights in the file")
+    src.add_argument("--dist-ingest", action="store_true",
+                     help="per-host sharded ingest: each process range-reads "
+                          "only its shards' edges (the MPI-IO per-rank "
+                          "slice analog, distgraph.cpp:69-203); requires "
+                          "--file and the bucketed engine")
     src.add_argument("--generate", "-n", type=int, metavar="NV",
                      help="generate an in-memory RGG with NV vertices")
     src.add_argument("--rmat", type=int, metavar="SCALE",
@@ -138,6 +143,18 @@ def validate(args) -> None:
         raise SystemExit("--et-delta must be in [0, 1]")
     if args.resume and not args.checkpoint_dir:
         raise SystemExit("--resume requires --checkpoint-dir")
+    if args.dist_ingest:
+        if not args.file:
+            raise SystemExit("--dist-ingest requires --file")
+        if args.engine not in ("auto", "bucketed"):
+            raise SystemExit("--dist-ingest supports only the bucketed "
+                             "engine")
+        if (args.coloring or args.vertex_ordering or args.checkpoint_dir
+                or args.write_graph):
+            raise SystemExit("--dist-ingest is incompatible with "
+                             "--coloring/--vertex-ordering/--checkpoint-dir/"
+                             "--write-graph (they need the full graph on "
+                             "every host)")
     if args.checkpoint_dir and args.one_phase:
         raise SystemExit("--checkpoint-dir is incompatible with --one-phase")
 
@@ -181,7 +198,13 @@ def main(argv=None) -> int:
     from cuvite_tpu.louvain.driver import louvain_phases
 
     t0 = time.perf_counter()
-    if args.file:
+    if args.file and args.dist_ingest:
+        from cuvite_tpu.io.dist_ingest import DistVite
+
+        graph = DistVite.load(args.file, args.shards, bits64=args.bits64,
+                              balanced=args.balanced)
+        name = args.file
+    elif args.file:
         graph = read_vite(args.file, bits64=args.bits64)
         name = args.file
     elif args.rmat is not None:
@@ -230,7 +253,12 @@ def main(argv=None) -> int:
     if args.trace:
         print(tracer.report())
 
-    q = modularity(graph, res.communities)
+    if args.dist_ingest:
+        # No process holds the full graph; the driver's distributed f64
+        # recompute already produced the reported value.
+        q = res.modularity
+    else:
+        q = modularity(graph, res.communities)
     teps = sum(p.num_edges * p.iterations for p in res.phases) / max(
         sum(p.seconds for p in res.phases), 1e-9)
     if not args.quiet:

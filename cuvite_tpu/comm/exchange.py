@@ -79,16 +79,44 @@ class ExchangePlan:
 
     @staticmethod
     def build(dg) -> "ExchangePlan":
+        """Full plan from an all-shards-resident DistGraph.  Per-host-ingest
+        partitions (``dg.local_only``) discover ghosts from LOCAL shards and
+        allgather the per-shard ghost id lists so every process can build
+        its rows of the routing — the literal exchangeVertexReqs flow
+        (scan local edges -> exchange referenced-vertex lists,
+        /root/reference/louvain.cpp:3118-3264); ``send_idx`` / ``ghost_sel``
+        then hold only this process's shard rows (place with place_block)."""
         S, nvp = dg.nshards, dg.nv_pad
-        ghost_ids = []
-        bounds = []
-        for s, sh in enumerate(dg.shards):
+        local_only = getattr(dg, "local_only", False)
+        lo, hi = (dg.local_lo, dg.local_hi) if local_only else (0, S)
+        ghost_local = []
+        for s in range(lo, hi):
+            sh = dg.shards[s]
             real = np.asarray(sh.src) < nvp
             d = np.asarray(sh.dst)[real].astype(np.int64)
             owned = (d >= s * nvp) & (d < (s + 1) * nvp)
-            gids = np.unique(d[~owned])
-            ghost_ids.append(gids)
-            bounds.append(np.searchsorted(gids, np.arange(S + 1) * nvp))
+            ghost_local.append(np.unique(d[~owned]))
+        if local_only:
+            # Host allgather of every shard's referenced-ghost list (the
+            # Alltoall sizes + id exchange of exchangeVertexReqs).
+            from cuvite_tpu.comm.multihost import allgather_varlen
+
+            lens = np.array([len(g) for g in ghost_local], dtype=np.int64)
+            flat = (np.concatenate(ghost_local) if ghost_local
+                    else np.zeros(0, dtype=np.int64))
+            lens_all = allgather_varlen(lens)
+            flat_all = allgather_varlen(flat)
+            ghost_ids = []
+            for ls, fl in zip(lens_all, flat_all):
+                off = 0
+                for n in ls:
+                    ghost_ids.append(fl[off: off + int(n)])
+                    off += int(n)
+            assert len(ghost_ids) == S
+        else:
+            ghost_ids = ghost_local
+        bounds = [np.searchsorted(g, np.arange(S + 1) * nvp)
+                  for g in ghost_ids]
         max_g = max((len(g) for g in ghost_ids), default=0)
         G = next_pow2(max(max_g, 1))
         B = 1
@@ -96,16 +124,22 @@ class ExchangePlan:
             if len(ghost_ids[s]):
                 B = max(B, int(np.max(np.diff(bounds[s]))))
         B = next_pow2(B)
-        send_idx = np.full((S, S, B), nvp, dtype=np.int32)
-        ghost_sel = np.zeros((S, G), dtype=np.int32)
+        # Rows this process materializes: all shards when fully resident,
+        # the local range under per-host ingest.
+        n_rows = hi - lo
+        send_idx = np.full((n_rows, S, B), nvp, dtype=np.int32)
+        ghost_sel = np.zeros((n_rows, G), dtype=np.int32)
         for s in range(S):
             gids, bnd = ghost_ids[s], bounds[s]
             for t in range(S):
                 ids = gids[bnd[t]:bnd[t + 1]]
-                if len(ids):
-                    send_idx[t, s, : len(ids)] = (ids - t * nvp).astype(
-                        np.int32)
-                    ghost_sel[s, bnd[t]:bnd[t + 1]] = (
+                if not len(ids):
+                    continue
+                if lo <= t < hi:
+                    send_idx[t - lo, s, : len(ids)] = (
+                        ids - t * nvp).astype(np.int32)
+                if lo <= s < hi:
+                    ghost_sel[s - lo, bnd[t]:bnd[t + 1]] = (
                         t * B + np.arange(len(ids), dtype=np.int32))
         return ExchangePlan(
             nshards=S, nv_pad=nvp, block=B, ghost_pad=G,

@@ -112,6 +112,65 @@ def place(mesh, arr, spec):
     return jax.make_array_from_process_local_data(sh, arr, arr.shape)
 
 
+def place_block(mesh, local_rows: np.ndarray, global_rows: int, spec):
+    """Create a global array whose axis-0 rows are sharded over ``mesh``
+    from ONLY this process's contiguous row block (per-host ingest path:
+    edge-sized arrays never exist in full on any host).  Single-process,
+    the local block IS the global array."""
+    from jax.sharding import NamedSharding
+
+    sh = NamedSharding(mesh, spec)
+    if not is_distributed():
+        return jax.device_put(local_rows, sh)
+    shape = (global_rows,) + tuple(local_rows.shape[1:])
+    return jax.make_array_from_process_local_data(
+        sh, np.ascontiguousarray(local_rows), shape)
+
+
+def allreduce_sum_host(x):
+    """Sum a small host value (scalar or ndarray) across processes."""
+    if not is_distributed():
+        return x
+    from jax.experimental import multihost_utils
+
+    parts = multihost_utils.process_allgather(np.asarray(x))
+    return parts.sum(axis=0)
+
+
+def allreduce_max_host(x: np.ndarray) -> np.ndarray:
+    """Element-wise max of a small host array across processes (used to
+    agree on padded plan shapes, which must be identical on every process
+    for the SPMD step to compile to one program)."""
+    if not is_distributed():
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    parts = multihost_utils.process_allgather(np.asarray(x))
+    return np.asarray(parts).max(axis=0)
+
+
+def allgather_varlen(arr: np.ndarray) -> list:
+    """All-gather one variable-length 1-D array per process; returns the
+    list of every process's array (the host analog of the reference's
+    Alltoall size exchange + Isend/Irecv id lists in exchangeVertexReqs,
+    /root/reference/louvain.cpp:3118-3264)."""
+    if not is_distributed():
+        return [np.asarray(arr)]
+    from jax.experimental import multihost_utils
+
+    arr = np.asarray(arr)
+    lens = multihost_utils.process_allgather(
+        np.array([len(arr)], dtype=np.int64))
+    lens = np.asarray(lens).reshape(-1)
+    m = max(int(lens.max()), 1)
+    # arr.dtype is valid even for empty arrays; every process MUST present
+    # the same dtype or the collective is malformed.
+    buf = np.zeros(m, dtype=arr.dtype)
+    buf[: len(arr)] = arr
+    allb = np.asarray(multihost_utils.process_allgather(buf))
+    return [allb[p, : int(lens[p])] for p in range(len(lens))]
+
+
 def gather_global(arr) -> np.ndarray:
     """Fetch a (possibly multi-host sharded) global jax array to a full host
     numpy array on EVERY process — the `MPI_Allgatherv` of the output path

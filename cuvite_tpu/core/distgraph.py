@@ -34,17 +34,23 @@ def uniform_parts(num_vertices: int, nshards: int) -> np.ndarray:
     return parts
 
 
-def balanced_parts(graph: Graph, nshards: int) -> np.ndarray:
-    """Edge-balanced contiguous ranges: each shard owns ~ne/nshards edges
-    (cf. balanceEdges, /root/reference/distgraph.cpp:22-66, the `-b` flag)."""
-    ne = graph.num_edges
-    nv = graph.num_vertices
+def balanced_parts_from_offsets(offsets, nv: int, ne: int,
+                                nshards: int) -> np.ndarray:
+    """Edge-balanced contiguous ranges from a CSR offset array — works on a
+    memmap, so the per-host ingest path shares the exact cut rule."""
     targets = (np.arange(1, nshards, dtype=np.int64) * ne) // nshards
-    cuts = np.searchsorted(graph.offsets[1:], targets, side="left") + 1
+    cuts = np.searchsorted(offsets[1:], targets, side="left") + 1
     parts = np.concatenate([[0], np.clip(cuts, 0, nv), [nv]]).astype(np.int64)
     # Enforce monotonicity if some shard would be empty.
     np.maximum.accumulate(parts, out=parts)
     return parts
+
+
+def balanced_parts(graph: Graph, nshards: int) -> np.ndarray:
+    """Edge-balanced contiguous ranges: each shard owns ~ne/nshards edges
+    (cf. balanceEdges, /root/reference/distgraph.cpp:22-66, the `-b` flag)."""
+    return balanced_parts_from_offsets(
+        graph.offsets, graph.num_vertices, graph.num_edges, nshards)
 
 
 @dataclasses.dataclass

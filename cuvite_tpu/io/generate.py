@@ -10,7 +10,11 @@
   distgraph.cpp:426-434), so the point set is bit-identical for a given
   (nv, nshards, seed=1).  Neighbor search uses a KD-tree instead of the
   reference's O(n^2) loops + up/down ghost Sendrecv (distgraph.cpp:483-620):
-  same edge set, not a translation.
+  same edge set, not a translation.  `-e` extra edges draw from a
+  documented LCG stream slice (seed+1) with the reference's deterministic
+  far-target weight function replicated bit-for-bit (see _rgg_extra_edges;
+  the reference's own pair draws are time(0)^getpid()-seeded and therefore
+  unreproducible even against itself, distgraph.cpp:706).
 - `generate_rmat`: Graph500-style R-MAT generator (a=0.57, b=0.19, c=0.19)
   for the benchmark configs in BASELINE.md (not present in the reference,
   which defers non-RGG formats to external converters, README:36-40).
@@ -75,20 +79,102 @@ def generate_rgg(
     src, dst, w = pairs[:, 0], pairs[:, 1], d
 
     if random_edge_percent > 0:
-        # Extra long-range edges, ~pct% of the local edge count
-        # (distgraph.cpp:652-842).  Random pairs, weight = distance.
-        n_extra = int(random_edge_percent * len(pairs)) // 100
-        rng = np.random.default_rng(seed)
-        es = rng.integers(0, nv_eff, size=n_extra)
-        ed_ = rng.integers(0, nv_eff, size=n_extra)
-        keep = es != ed_
-        es, ed_ = es[keep], ed_[keep]
-        wx = np.sqrt(((pts[es] - pts[ed_]) ** 2).sum(axis=1))
+        es, ed_, wx = _rgg_extra_edges(
+            pts, nshards, n, nv_eff, random_edge_percent,
+            len(pairs), np.stack([src, dst], axis=1), seed,
+        )
         src = np.concatenate([src, es])
         dst = np.concatenate([dst, ed_])
         w = np.concatenate([w, wx])
 
     return Graph.from_edges(nv_eff, src, dst, weights=w, policy=policy)
+
+
+def _rgg_extra_edges(pts, nshards, n, nv, pct, n_undirected, existing,
+                     seed):
+    """Extra long-range edges, ~pct% of the global undirected edge count
+    (the `-e` flag; /root/reference/distgraph.cpp:652-842).
+
+    Reference-parity semantics, with the deterministic pieces replicated
+    exactly and the one non-reproducible piece replaced (and documented):
+
+    - count: nrande = pct * total_undirected / 100, split evenly per rank
+      with the remainder on the LAST rank; when nrande < nranks the whole
+      count goes to the last rank (the reference leaves pnrande
+      uninitialized for the other ranks there, distgraph.cpp:661-667 — a
+      documented quirk; here they draw 0).
+    - draws: rank r draws (local i in [0, n), global j in [0, nv)) pairs.
+      The reference seeds this stream with time(0)^getpid()
+      (distgraph.cpp:706) — NON-reproducible by design, so no bitwise
+      cross-validation of the pair set is possible even between two runs
+      of the reference itself.  Here the draws come from slice
+      [2*r*quota, 2*(r+1)*quota) of the Park-Miller LCG stream for
+      seed+1 (the same engine family as the reference's
+      default_random_engine = minstd_rand0), making `-e` runs fully
+      reproducible for a given (nv, nshards, seed).
+    - skips forfeit the draw (reference `continue`): a self-pair or a
+      duplicate of an existing/earlier edge reduces the inserted count,
+      not re-drawn.  (The reference compares LOCAL indices for the
+      self-test, distgraph.cpp:722, and checks only the (i, g_j)
+      direction for duplicates, :728-731; here: global-id self-test and
+      undirected duplicate test.)
+    - weight: Euclidean distance when the target rank is self or a strip
+      neighbor (the ranks whose coordinates the reference holds); for far
+      targets the reference's deterministic hash-seeded weight
+      uniform[0.01, 1.0) from minstd_rand0(g_i*nv + g_j) — replicated
+      bit-for-bit in utils.rng.minstd0_uniform_real.
+    """
+    from cuvite_tpu.utils.rng import minstd0_uniform_real
+
+    nrande = (pct * n_undirected) // 100
+    if nrande <= 0:
+        z = np.zeros(0, dtype=np.int64)
+        return z, z, np.zeros(0, dtype=np.float64)
+    counts = np.zeros(nshards, dtype=np.int64)
+    if nrande < nshards:
+        counts[-1] = nrande
+    else:
+        counts[:] = nrande // nshards
+        counts[-1] += nrande % nshards
+    offs = np.concatenate([[0], np.cumsum(counts)])
+    total = int(offs[-1])
+    gi_parts, gj_parts = [], []
+    for r in range(nshards):
+        c = int(counts[r])
+        if c == 0:
+            continue
+        vals = lcg_stream(seed + 1, 2 * total,
+                          lo=2 * int(offs[r]), hi=2 * int(offs[r + 1]))
+        i_loc = np.minimum((vals[0::2] * n).astype(np.int64), n - 1)
+        g_j = np.minimum((vals[1::2] * nv).astype(np.int64), nv - 1)
+        gi_parts.append(r * n + i_loc)
+        gj_parts.append(g_j)
+    g_i = np.concatenate(gi_parts)
+    g_j = np.concatenate(gj_parts)
+
+    keep = g_i != g_j
+    # Undirected duplicate check against the RGG edge set and earlier
+    # extras (first occurrence wins, like the sequential insertion).
+    lo_ = np.minimum(g_i, g_j)
+    hi_ = np.maximum(g_i, g_j)
+    key = lo_ * nv + hi_
+    ex_key = (np.minimum(existing[:, 0], existing[:, 1]) * nv
+              + np.maximum(existing[:, 0], existing[:, 1]))
+    keep &= ~np.isin(key, ex_key)
+    _, first = np.unique(key, return_index=True)
+    is_first = np.zeros(len(key), dtype=bool)
+    is_first[first] = True
+    keep &= is_first
+    g_i, g_j = g_i[keep], g_j[keep]
+
+    owner_i = g_i // n
+    owner_j = g_j // n
+    near = np.abs(owner_i - owner_j) <= 1
+    dist = np.sqrt(((pts[g_i] - pts[g_j]) ** 2).sum(axis=1))
+    wfar = minstd0_uniform_real(
+        (g_i.astype(np.uint64) * np.uint64(nv) + g_j.astype(np.uint64)),
+        0.01, 1.0)
+    return g_i, g_j, np.where(near, dist, wfar)
 
 
 def rmat_edges_numpy(scale: int, ne: int, seed: int, a: float, b: float,

@@ -178,57 +178,76 @@ def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
     remapped into each shard's extended-local space [0, nv_pad + ghost_pad)
     — the layout the sparse-exchange step gathers from — and self-loop
     detection switches to the local formulation (base=0: remapped self edge
-    has dst == src local index)."""
+    has dst == src local index).
+
+    Per-host-ingest partitions (``dg.local_only``, io/dist_ingest.py) build
+    plans for THIS process's shard rows only; the padded shapes (which must
+    be identical on every process for one SPMD program) are agreed by a
+    host max-allreduce, and the returned arrays' leading dim covers local
+    shards only — place them with comm.multihost.place_block."""
     nshards = dg.nshards
     nvl = dg.nv_pad
+    local_only = getattr(dg, "local_only", False)
+    lo, hi = (dg.local_lo, dg.local_hi) if local_only else (0, nshards)
+    sids = range(lo, hi)
     if exchange_plan is not None:
         plans = [
             BucketPlan.build(
-                np.asarray(sh.src),
+                np.asarray(dg.shards[s].src),
                 exchange_plan.remap_dst(
-                    s, np.asarray(sh.src), np.asarray(sh.dst)
-                ).astype(np.asarray(sh.dst).dtype),
-                np.asarray(sh.w),
+                    s, np.asarray(dg.shards[s].src),
+                    np.asarray(dg.shards[s].dst)
+                ).astype(np.asarray(dg.shards[s].dst).dtype),
+                np.asarray(dg.shards[s].w),
                 nv_local=nvl, base=0, widths=widths,
             )
-            for s, sh in enumerate(dg.shards)
+            for s in sids
         ]
     else:
         plans = [
             BucketPlan.build(
-                np.asarray(sh.src), np.asarray(sh.dst), np.asarray(sh.w),
+                np.asarray(dg.shards[s].src), np.asarray(dg.shards[s].dst),
+                np.asarray(dg.shards[s].w),
                 nv_local=nvl, base=s * nvl, widths=widths,
             )
-            for s, sh in enumerate(dg.shards)
+            for s in sids
         ]
+    n_rows = len(plans)
     by_width = [{b.width: b for b in p.buckets} for p in plans]
+    shape_req = np.array(
+        [max((len(bw[w].verts) for bw in by_width if w in bw), default=0)
+         for w in widths]
+        + [max(len(p.heavy_src) for p in plans)], dtype=np.int64)
+    if local_only:
+        from cuvite_tpu.comm.multihost import allreduce_max_host
+
+        shape_req = allreduce_max_host(shape_req)
     stacked_buckets = []
-    for width in widths:
-        nbs = [len(bw[width].verts) if width in bw else 0 for bw in by_width]
-        nb = max(nbs)
+    for wi, width in enumerate(widths):
+        nb = int(shape_req[wi])
         if nb == 0:
             continue
-        verts = np.full((nshards, nb), nvl, dtype=np.int64)
-        dmat = np.zeros((nshards, nb, width), dtype=plans[0].heavy_dst.dtype)
-        wmat = np.zeros((nshards, nb, width), dtype=plans[0].heavy_w.dtype)
-        for s, bw in enumerate(by_width):
+        verts = np.full((n_rows, nb), nvl, dtype=np.int64)
+        dmat = np.zeros((n_rows, nb, width), dtype=plans[0].heavy_dst.dtype)
+        wmat = np.zeros((n_rows, nb, width), dtype=plans[0].heavy_w.dtype)
+        for r, bw in enumerate(by_width):
             if width in bw:
                 b = bw[width]
-                verts[s, : len(b.verts)] = b.verts
-                dmat[s, : len(b.verts)] = b.dst
-                wmat[s, : len(b.verts)] = b.w
+                verts[r, : len(b.verts)] = b.verts
+                dmat[r, : len(b.verts)] = b.dst
+                wmat[r, : len(b.verts)] = b.w
         stacked_buckets.append(
             (verts.reshape(-1), dmat.reshape(-1, width),
              wmat.reshape(-1, width))
         )
-    hn = max(len(p.heavy_src) for p in plans)
-    hsrc = np.full((nshards, hn), nvl, dtype=plans[0].heavy_src.dtype)
-    hdst = np.zeros((nshards, hn), dtype=plans[0].heavy_dst.dtype)
-    hw = np.zeros((nshards, hn), dtype=plans[0].heavy_w.dtype)
-    for s, p in enumerate(plans):
-        hsrc[s, : len(p.heavy_src)] = p.heavy_src
-        hdst[s, : len(p.heavy_dst)] = p.heavy_dst
-        hw[s, : len(p.heavy_w)] = p.heavy_w
+    hn = int(shape_req[-1])
+    hsrc = np.full((n_rows, hn), nvl, dtype=plans[0].heavy_src.dtype)
+    hdst = np.zeros((n_rows, hn), dtype=plans[0].heavy_dst.dtype)
+    hw = np.zeros((n_rows, hn), dtype=plans[0].heavy_w.dtype)
+    for r, p in enumerate(plans):
+        hsrc[r, : len(p.heavy_src)] = p.heavy_src
+        hdst[r, : len(p.heavy_dst)] = p.heavy_dst
+        hw[r, : len(p.heavy_w)] = p.heavy_w
     self_loop = np.concatenate([p.self_loop for p in plans])
     return StackedPlan(
         buckets=stacked_buckets,

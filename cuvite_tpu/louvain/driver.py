@@ -363,6 +363,26 @@ class PhaseRunner:
             use_sparse = exchange == "sparse"
             adt_np = np.dtype(adt)
             S = dg.nshards
+            local_only = getattr(dg, "local_only", False)
+            if local_only and not use_sparse:
+                raise ValueError(
+                    "per-host ingest (DistVite) requires exchange='sparse' "
+                    "— the replicated exchange needs full host arrays")
+            S_rows = (dg.local_hi - dg.local_lo) if local_only else S
+
+            def _place(arr):
+                # Plan arrays' leading dim covers S_rows shard rows; the
+                # global array covers S.  Fully-resident partitions place
+                # the whole array; per-host ingest contributes its block.
+                if not local_only:
+                    return shard_1d(mesh, arr)
+                from jax.sharding import PartitionSpec as P
+
+                from cuvite_tpu.comm.multihost import place_block
+
+                rows = (arr.shape[0] // S_rows) * S
+                return place_block(mesh, arr, rows, P(VERTEX_AXIS))
+
             if use_sparse:
                 from cuvite_tpu.comm.exchange import ExchangePlan
 
@@ -373,10 +393,10 @@ class PhaseRunner:
                 budget = min(int(budget), dg.nv_pad)
                 self.budget = budget
                 plan = build_stacked_plans(dg, exchange_plan=xplan)
-                self._send_idx = shard_1d(
-                    mesh, xplan.send_idx.reshape(S * S, xplan.block))
-                self._ghost_sel = shard_1d(
-                    mesh, xplan.ghost_sel.reshape(-1))
+                self._send_idx = _place(
+                    xplan.send_idx.reshape(S_rows * S, xplan.block))
+                self._ghost_sel = _place(
+                    xplan.ghost_sel.reshape(S_rows * xplan.ghost_pad))
                 sparse_cfg = (S, budget)
                 key = ("bucketed-sparse",
                        tuple(d.id for d in mesh.devices.flat),
@@ -388,16 +408,16 @@ class PhaseRunner:
                 key = ("bucketed", tuple(d.id for d in mesh.devices.flat),
                        len(plan.buckets), nv_total, sentinel, adt_np.name)
             buckets = tuple(
-                (shard_1d(mesh, v.astype(vdt)),
-                 shard_1d(mesh, d.astype(vdt)),
-                 shard_1d(mesh, ww.astype(wdt)))
+                (_place(v.astype(vdt)),
+                 _place(d.astype(vdt)),
+                 _place(ww.astype(wdt)))
                 for v, d, ww in plan.buckets
             )
             heavy = tuple(
-                shard_1d(mesh, a.astype(t))
+                _place(a.astype(t))
                 for a, t in zip(plan.heavy, (vdt, vdt, wdt))
             )
-            self_loop = shard_1d(mesh, plan.self_loop.astype(wdt))
+            self_loop = _place(plan.self_loop.astype(wdt))
             step_fn = _STEP_CACHE.get(key)
             if step_fn is None:
                 step_fn = make_sharded_bucketed_step(
@@ -834,7 +854,32 @@ def louvain_phases(
     the color loop (louvain.cpp:1535-1562).  Ordering is implemented on the
     single-shard bucketed engine; other engines fall back to the plain
     schedule."""
-    if mesh is None and nshards > 1:
+    dist_ingest = getattr(graph, "local_only", False)
+    if dist_ingest:
+        # Per-host sharded ingest (io/dist_ingest.DistVite): phase 0 runs on
+        # the pre-partitioned local slabs; later (small) phases on the
+        # allgathered coarse graph.  Full-graph host features are
+        # unavailable by construction.
+        if nshards == 1 and graph.nshards > 1:
+            nshards = graph.nshards
+        if nshards != graph.nshards:
+            raise ValueError(
+                f"nshards={nshards} does not match the DistVite partition "
+                f"({graph.nshards} shards)")
+        if engine not in ("auto", "bucketed"):
+            raise ValueError(
+                "per-host ingest supports only the bucketed engine")
+        if exchange != "sparse":
+            raise ValueError("per-host ingest requires exchange='sparse'")
+        if coloring or vertex_ordering:
+            raise ValueError(
+                "coloring/vertex-ordering need the full phase-0 graph on "
+                "every host; load it fully (read_vite) instead of DistVite")
+        if checkpoint_dir:
+            raise ValueError(
+                "checkpointing needs the full original graph for its "
+                "content fingerprint; use full ingest")
+    if mesh is None and (nshards > 1 or dist_ingest):
         mesh = make_mesh(nshards)
     if engine == "auto":
         engine = "bucketed"
@@ -935,10 +980,13 @@ def louvain_phases(
         th = threshold_for_phase(phase) if (threshold_cycling and not one_phase) \
             else threshold
         t1 = time.perf_counter()
+        g_is_dv = getattr(g, "local_only", False)
+        g_nv = g.num_vertices
+        g_ne = g.num_edges
         # Shape floors: every coarsened phase small enough to fit them reuses
         # one compiled step instead of recompiling per phase.
         with tracer.stage("plan"):
-            dg = DistGraph.build(
+            dg = g if g_is_dv else DistGraph.build(
                 g, nshards, balanced=balanced,
                 min_nv_pad=max(1, 4096 // nshards),
                 min_ne_pad=max(1, 16384 // nshards),
@@ -1015,11 +1063,16 @@ def louvain_phases(
         # accumulation (louvain.cpp:2433-2481).  The device ds pass is used
         # only when the slab is already resident (sort engine).
         with tracer.stage("evaluate"):
-            curr_mod = phase_modularity(dg, comm_pad,
-                                        device_slab=_runner_slab(runner))
+            if g_is_dv:
+                # Per-host ingest: f64 e-term from local slabs + host
+                # allreduce (no full graph exists anywhere).
+                curr_mod = dg.modularity(comm_pad)
+            else:
+                curr_mod = phase_modularity(
+                    dg, comm_pad, device_slab=_runner_slab(runner))
         t2 = time.perf_counter()
         tot_iters += iters
-        tracer.count("traversed_edges", g.num_edges * iters)
+        tracer.count("traversed_edges", g_ne * iters)
         if dist_stats:
             from cuvite_tpu.utils.trace import dist_stats_report
 
@@ -1045,18 +1098,29 @@ def louvain_phases(
             comm_all = dense[comm_all]
             phases.append(PhaseStats(
                 phase=phase, modularity=curr_mod, iterations=iters,
-                num_vertices=g.num_vertices, num_edges=g.num_edges,
+                num_vertices=g_nv, num_edges=g_ne,
                 seconds=t2 - t1,
             ))
             if verbose:
                 print(f"Level {phase}, Modularity: {curr_mod:.6f}, "
-                      f"Iterations: {iters}, nv: {g.num_vertices}, "
+                      f"Iterations: {iters}, nv: {g_nv}, "
                       f"time: {t2 - t1:.3f}s")
             if one_phase:
                 prev_mod = curr_mod
                 break
             with tracer.stage("coarsen"):
-                g = coarsen_graph(g, dense, nc)
+                if g_is_dv:
+                    # send_newEdges analog: local coarse triples,
+                    # allgathered, rebuilt identically on every process.
+                    dense_pad = np.zeros(dg.total_padded_vertices,
+                                         dtype=np.int64)
+                    dense_pad[dg.old_to_pad] = dense
+                    cs, cd, cw = dg.coarse_edges(dense_pad, nc)
+                    g = Graph.from_edges(
+                        nc, cs, cd, weights=cw, symmetrize=False,
+                        policy=dg.graph.policy)
+                else:
+                    g = coarsen_graph(g, dense, nc)
             prev_mod = curr_mod
             phase += 1
             if checkpoint_dir:
@@ -1086,8 +1150,11 @@ def louvain_phases(
                 comm_pad, curr_mod, iters = _run_with_budget(
                     1.0e-6, lower=-1.0)
                 with tracer.stage("evaluate"):
-                    curr_mod = phase_modularity(dg, comm_pad,
-                                                device_slab=_runner_slab(runner))
+                    if g_is_dv:
+                        curr_mod = dg.modularity(comm_pad)
+                    else:
+                        curr_mod = phase_modularity(
+                            dg, comm_pad, device_slab=_runner_slab(runner))
                 tot_iters += iters
                 comm_old = comm_pad[dg.old_to_pad]
                 if (curr_mod - prev_mod) > 1.0e-6:
@@ -1096,7 +1163,7 @@ def louvain_phases(
                     prev_mod = curr_mod
                     phases.append(PhaseStats(
                         phase=phase, modularity=curr_mod, iterations=iters,
-                        num_vertices=g.num_vertices, num_edges=g.num_edges,
+                        num_vertices=g_nv, num_edges=g_ne,
                         seconds=time.perf_counter() - t1,
                     ))
             break

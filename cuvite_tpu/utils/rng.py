@@ -111,6 +111,30 @@ def lcg_stream(seed: int, total: int, lo: int = 0, hi: int | None = None) -> np.
     return out.astype(np.float64) * mult
 
 
+def minstd0_uniform_real(seed32: np.ndarray, lo: float, hi: float) -> np.ndarray:
+    """Vectorized libstdc++ `uniform_real_distribution<double>(lo, hi)`
+    drawn from a freshly-seeded `minstd_rand0` — the reference's
+    deterministic far-edge weight function
+    (/root/reference/distgraph.cpp:755-757: `std::hash` of an integral is
+    the identity in libstdc++, truncated to `unsigned`, so the weight is a
+    pure function of the endpoint pair; replicated here bit-for-bit).
+
+    libstdc++ mechanics: engine seed x0 = seed mod M (0 -> 1); two draws
+    d = 16807*x mod M; generate_canonical<double, 53> with k = 2, r = M-1:
+    ret = ((d1-1) + (d2-1)*r) / r^2, accumulated in double; result
+    lo + ret*(hi-lo) ... note libstdc++ computes (hi-lo)*ret + lo.
+    """
+    x = (np.asarray(seed32, dtype=np.uint64) & np.uint64(0xFFFFFFFF)) \
+        % np.uint64(MLCG)
+    x = np.where(x == 0, np.uint64(1), x).astype(np.int64)
+    d1 = (x * ALCG) % MLCG
+    d2 = (d1 * ALCG) % MLCG
+    r = np.float64(MLCG - 1)
+    canon = ((d1 - 1).astype(np.float64)
+             + (d2 - 1).astype(np.float64) * r) / (r * r)
+    return (hi - lo) * canon + lo
+
+
 # ---------------------------------------------------------------------------
 # Counter-based RNG (SplitMix64): stateless hash RNG used by the synthetic
 # graph generators.  Trivially parallel (no stream to split), and the exact

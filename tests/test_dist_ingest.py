@@ -1,0 +1,87 @@
+"""Per-host sharded ingest (io/dist_ingest.DistVite).
+
+Single-process, all shards are local, so DistVite must reproduce the
+full-ingest DistGraph pipeline exactly: same partition, same slabs, same
+final communities.  The 2-process variant lives in test_multihost.py.
+"""
+
+import numpy as np
+import pytest
+
+from cuvite_tpu.core.distgraph import DistGraph
+from cuvite_tpu.io.dist_ingest import DistVite
+from cuvite_tpu.io.vite import write_vite
+from cuvite_tpu.louvain.driver import louvain_phases
+
+
+@pytest.fixture(scope="module")
+def karate_bin(tmp_path_factory):
+    import networkx as nx
+
+    from cuvite_tpu.core.graph import Graph
+
+    e = np.array(nx.karate_club_graph().edges(), dtype=np.int64)
+    g = Graph.from_edges(34, e[:, 0], e[:, 1])
+    p = str(tmp_path_factory.mktemp("dv") / "karate.bin")
+    write_vite(p, g)
+    return p, g
+
+
+def test_distvite_matches_distgraph_layout(karate_bin):
+    path, g = karate_bin
+    dv = DistVite.load(path, 4, min_nv_pad=1024, min_ne_pad=4096)
+    dg = DistGraph.build(g, 4, min_nv_pad=1024, min_ne_pad=4096)
+    assert dv.nv_pad == dg.nv_pad and dv.ne_pad == dg.ne_pad
+    assert np.array_equal(dv.parts, dg.parts)
+    assert np.array_equal(dv.old_to_pad, dg.old_to_pad)
+    assert np.array_equal(dv.pad_to_old, dg.pad_to_old)
+    assert np.allclose(dv.padded_weighted_degrees(),
+                       dg.padded_weighted_degrees())
+    assert dv.graph.total_edge_weight_twice() == pytest.approx(
+        g.total_edge_weight_twice())
+    for s in range(4):
+        assert np.array_equal(dv.shards[s].src, dg.shards[s].src)
+        assert np.array_equal(dv.shards[s].dst, dg.shards[s].dst)
+        assert np.allclose(dv.shards[s].w, dg.shards[s].w)
+        assert dv.shards[s].n_real_edges == dg.shards[s].n_real_edges
+
+
+def test_distvite_run_matches_full_ingest(karate_bin):
+    path, g = karate_bin
+    dv = DistVite.load(path, 8)
+    res_dv = louvain_phases(dv)
+    res_full = louvain_phases(g, nshards=8)
+    assert np.array_equal(res_dv.communities, res_full.communities)
+    assert res_dv.modularity == pytest.approx(res_full.modularity, abs=1e-9)
+
+
+def test_distvite_balanced_parts(karate_bin):
+    path, g = karate_bin
+    dv = DistVite.load(path, 4, balanced=True)
+    dg = DistGraph.build(g, 4, balanced=True)
+    assert np.array_equal(dv.parts, dg.parts)
+
+
+def test_distvite_modularity_oracle(karate_bin):
+    path, g = karate_bin
+    from cuvite_tpu.evaluate.modularity import modularity
+
+    dv = DistVite.load(path, 4)
+    # identity assignment in padded space
+    ident = np.arange(dv.total_padded_vertices, dtype=np.int64)
+    q_dv = dv.modularity(ident)
+    q_ref = modularity(g, np.arange(g.num_vertices))
+    assert q_dv == pytest.approx(q_ref, abs=1e-12)
+
+
+def test_distvite_rejects_unsupported_modes(karate_bin):
+    path, _ = karate_bin
+    dv = DistVite.load(path, 8)
+    with pytest.raises(ValueError, match="coloring"):
+        louvain_phases(dv, coloring=4)
+    with pytest.raises(ValueError, match="sparse"):
+        louvain_phases(dv, exchange="replicated")
+    with pytest.raises(ValueError, match="fingerprint|full"):
+        louvain_phases(dv, checkpoint_dir="/tmp/nope")
+    with pytest.raises(ValueError, match="bucketed"):
+        louvain_phases(dv, engine="sort")

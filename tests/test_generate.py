@@ -86,3 +86,75 @@ def test_rmat_deterministic():
     g1 = generate_rmat(8, seed=7)
     g2 = generate_rmat(8, seed=7)
     np.testing.assert_array_equal(g1.tails, g2.tails)
+
+
+def test_minstd0_weight_matches_libstdcxx_oracle(tmp_path):
+    """The far-target extra-edge weight must be bit-identical to the
+    reference's actual C++ expression (distgraph.cpp:755-757): an
+    identity-hash-seeded minstd_rand0 driving
+    uniform_real_distribution<double>(0.01, 1.0).  Oracle: compile and run
+    that exact standard-library expression with the system g++."""
+    import subprocess
+    import sys
+
+    from cuvite_tpu.utils.rng import minstd0_uniform_real
+
+    src = tmp_path / "oracle.cpp"
+    src.write_text(
+        "#include <cstdint>\n#include <cstdio>\n#include <random>\n"
+        "#include <functional>\n"
+        "int main(int argc, char** argv) {\n"
+        "  for (int k = 1; k < argc; ++k) {\n"
+        "    long long key = atoll(argv[k]);\n"
+        "    std::hash<long long> reh;\n"
+        "    unsigned seed = (unsigned)reh(key);\n"
+        "    std::default_random_engine re(seed);\n"
+        "    std::uniform_real_distribution<double> d;\n"
+        "    double w = d(re, std::uniform_real_distribution<double>::"
+        "param_type{0.01, 1.0});\n"
+        "    printf(\"%.17g\\n\", w);\n"
+        "  }\n  return 0;\n}\n"
+    )
+    exe = tmp_path / "oracle"
+    subprocess.run(["g++", "-O2", "-o", str(exe), str(src)], check=True)
+    keys = np.array([0, 1, 7, 2147483646, 2147483647, 123456789012345,
+                     34 * 34 + 5, 2**31, 2**32 - 1, 2**32, 987654321],
+                    dtype=np.int64)
+    out = subprocess.run([str(exe)] + [str(k) for k in keys],
+                         capture_output=True, text=True, check=True)
+    oracle = np.array([float(x) for x in out.stdout.split()])
+    ours = minstd0_uniform_real(keys.astype(np.uint64), 0.01, 1.0)
+    np.testing.assert_array_equal(ours, oracle)
+
+
+def test_rgg_extra_edges_deterministic_and_weighted():
+    g1 = generate_rgg(512, nshards=4, random_edge_percent=20, seed=1)
+    g2 = generate_rgg(512, nshards=4, random_edge_percent=20, seed=1)
+    np.testing.assert_array_equal(g1.tails, g2.tails)
+    np.testing.assert_array_equal(g1.weights, g2.weights)
+    base = generate_rgg(512, nshards=4, seed=1)
+    # ~20% extra undirected edges, minus self/duplicate forfeits
+    extra = (g1.num_edges - base.num_edges) // 2
+    target = (20 * (base.num_edges // 2)) // 100
+    assert 0 < extra <= target
+    assert extra > target // 2
+    g3 = generate_rgg(512, nshards=4, random_edge_percent=20, seed=2)
+    assert not np.array_equal(g1.tails, g3.tails)
+
+
+def test_rgg_extra_far_weights_in_range():
+    """Far-target extra edges carry the hash-seeded uniform[0.01, 1.0)
+    weight; near (strip-neighbor) targets carry the true distance."""
+    from cuvite_tpu.io.generate import _rgg_extra_edges, rgg_points
+
+    nv, p = 512, 4
+    n = nv // p
+    x, y = rgg_points(nv, p, 1)
+    pts = np.stack([x, y], axis=1)
+    gi, gj, w = _rgg_extra_edges(pts, p, n, nv, 50, 1000,
+                                 np.zeros((0, 2), dtype=np.int64), 1)
+    far = np.abs(gi // n - gj // n) > 1
+    assert far.any() and (~far).any()
+    assert np.all(w[far] >= 0.01) and np.all(w[far] < 1.0)
+    d = np.sqrt(((pts[gi[~far]] - pts[gj[~far]]) ** 2).sum(axis=1))
+    np.testing.assert_allclose(w[~far], d)
