@@ -742,73 +742,130 @@ class PhaseRunner:
         return gather_global(past), prev_mod, iters, overflow
 
 
+# Edge-slab size above which the fused driver compacts between device
+# calls: one fused phase on a big slab, host coarsening (which SHRINKS the
+# graph, rebuild.cpp:430-454), repeat — so phase p costs O(E_p), not
+# O(E_original).  Below it, relabel-only phases on the resident slab are
+# cheaper than extra compiles + transfers.
+FUSED_SHRINK_EDGES = 1 << 20
+
+
 def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
                max_phases, verbose, tracer):
-    """Single-shard fused execution: one device call for the whole
-    clustering (cuvite_tpu/louvain/fused.py), one host sync at the end.
+    """Single-shard fused execution (cuvite_tpu/louvain/fused.py).
+
+    Small graphs: ONE device call for the whole clustering, one host sync.
+    Large graphs (>= FUSED_SHRINK_EDGES edges): one fused call per phase
+    with host compaction in between until the working graph is small, then
+    one fused call for all remaining phases — the asymptotics of real
+    coarsening with a handful of host syncs instead of one per iteration.
     ``tracer`` is always supplied by louvain_phases (NullTracer default)."""
     from cuvite_tpu.louvain.fused import fused_louvain
 
     t_start = time.perf_counter()
-    with tracer.stage("plan"):
-        dg = DistGraph.build(graph, 1, balanced=balanced)
-    sh = dg.shards[0]
-    nv_pad = dg.nv_pad
     wdt = _device_dtype(graph.policy.weight_dtype)
     adt = np.dtype(_device_dtype(graph.policy.accum_dtype)).name
     max_p = 1 if one_phase else int(max_phases)
-    if threshold_cycling and not one_phase:
-        ths = np.array([threshold_for_phase(p) for p in range(max_p)],
-                       dtype=wdt)
-    else:
-        ths = np.full(max_p, threshold, dtype=wdt)
+    cycling = bool(threshold_cycling and not one_phase)
+
+    def _ths(phase0: int) -> np.ndarray:
+        # Fixed length max_p regardless of the phase offset: contents are
+        # traced, so multilevel calls never retrace on the offset.
+        if cycling:
+            return np.array(
+                [threshold_for_phase(phase0 + k) for k in range(max_p)],
+                dtype=wdt)
+        return np.full(max_p, threshold, dtype=wdt)
+
     constant = jnp.asarray(1.0 / graph.total_edge_weight_twice(), dtype=wdt)
 
-    with tracer.stage("iterate"):
-        out = fused_louvain(
-            jnp.asarray(np.asarray(sh.src).astype(np.int32)),
-            jnp.asarray(np.asarray(sh.dst).astype(np.int32)),
-            jnp.asarray(np.asarray(sh.w).astype(wdt)),
-            jnp.asarray(ths),
-            constant,
-            jnp.asarray(dg.vertex_mask()),
-            nv_pad=nv_pad,
-            max_phases=max_p,
-            accum_dtype=adt,
-            cycling=bool(threshold_cycling and not one_phase),
-        )
-        # Slot 1 is the fused loop's own f32 converged modularity; the
-        # reported value is recomputed precisely below from `labels`.
-        (labels, _loop_mod, n_phases, tot_iters, mod_hist, iter_hist,
-         nc_hist) = jax.device_get(out)
-    total_s = time.perf_counter() - t_start
-    tracer.count("traversed_edges", graph.num_edges * int(tot_iters))
+    g = graph
+    comm_all = np.arange(graph.num_vertices, dtype=np.int64)
+    phases: list[PhaseStats] = []
+    tot_iters = 0
+    prev_mod = -1.0
+    force_final = False
+    while True:
+        with tracer.stage("plan"):
+            dg = DistGraph.build(g, 1, balanced=balanced,
+                                 min_nv_pad=4096, min_ne_pad=16384)
+        sh = dg.shards[0]
+        remaining = max_p - len(phases)
+        # Big slab: run ONE phase, compact on host, come back.  Small (or
+        # final) slab: let the device program run everything remaining.
+        one_phase_level = (g.num_edges >= FUSED_SHRINK_EDGES
+                           and remaining > 1 and not force_final)
+        budget = 1 if one_phase_level else remaining
+        with tracer.stage("iterate"):
+            out = fused_louvain(
+                jnp.asarray(np.asarray(sh.src).astype(np.int32)),
+                jnp.asarray(np.asarray(sh.dst).astype(np.int32)),
+                jnp.asarray(np.asarray(sh.w).astype(wdt)),
+                jnp.asarray(_ths(len(phases))),
+                constant,
+                jnp.asarray(dg.vertex_mask()),
+                nv_pad=dg.nv_pad,
+                max_phases=max_p,
+                accum_dtype=adt,
+                # Safety-net pass belongs to the LAST call only (the analog
+                # of main.cpp:432-442 running once, after the phase loop).
+                cycling=cycling and not one_phase_level,
+                prev_mod0=np.asarray(prev_mod, dtype=wdt),
+                phase_budget=np.int32(budget),
+                phase0=np.int32(len(phases)),
+                iter_budget=np.int32(MAX_TOTAL_ITERATIONS - tot_iters),
+            )
+            (labels, loop_mod, n_phases, iters, mod_hist, iter_hist,
+             nc_hist) = jax.device_get(out)
+        n_phases = int(n_phases)
+        tot_iters += int(iters)
+        tracer.count("traversed_edges", g.num_edges * int(iters))
+        nv_p = g.num_vertices
+        for p in range(n_phases):
+            phases.append(PhaseStats(
+                phase=len(phases), modularity=float(mod_hist[p]),
+                iterations=int(iter_hist[p]), num_vertices=nv_p,
+                num_edges=g.num_edges,
+                seconds=0.0,  # per-call split below
+            ))
+            nv_p = int(nc_hist[p])
+            if verbose:
+                st = phases[-1]
+                print(f"Level {st.phase}, Modularity: {st.modularity:.6f}, "
+                      f"Iterations: {st.iterations}, nv: {st.num_vertices}")
+        if n_phases:
+            comm_lvl = np.asarray(labels)[dg.old_to_pad]
+            dense, nc = renumber_communities(comm_lvl)
+            comm_all = dense[comm_all]
+            prev_mod = float(loop_mod)
+        if n_phases < budget:
+            # Stopped by no-gain (or the iteration cap).  If that happened
+            # on an intermediate call — which runs with cycling=False — the
+            # 1e-6 safety-net pass hasn't had its chance yet: run one final
+            # call on the SAME graph with the full cycling semantics.
+            if (one_phase_level and cycling and not force_final
+                    and tot_iters <= MAX_TOTAL_ITERATIONS):
+                force_final = True
+                continue
+            break
+        if (len(phases) >= max_p or not one_phase_level
+                or tot_iters > MAX_TOTAL_ITERATIONS):
+            break
+        with tracer.stage("coarsen"):
+            g = coarsen_graph(g, dense, nc)
 
-    n_phases = int(n_phases)
-    tot_iters = int(tot_iters)
-    comm_all = np.asarray(labels)[dg.old_to_pad]
-    dense_all, _ = renumber_communities(comm_all)
-    phases = []
-    nv_p = graph.num_vertices
-    for p in range(n_phases):
-        phases.append(PhaseStats(
-            phase=p, modularity=float(mod_hist[p]),
-            iterations=int(iter_hist[p]), num_vertices=nv_p,
-            # The fused engine relabels instead of aggregating, so every
-            # phase traverses the full edge slab.
-            num_edges=graph.num_edges,
-            seconds=total_s / max(n_phases, 1),
-        ))
-        nv_p = int(nc_hist[p])
-        if verbose:
-            st = phases[-1]
-            print(f"Level {st.phase}, Modularity: {st.modularity:.6f}, "
-                  f"Iterations: {st.iterations}, nv: {st.num_vertices}")
+    total_s = time.perf_counter() - t_start
+    for st in phases:
+        st.seconds = total_s / max(len(phases), 1)
+    # comm_all is already dense: every gaining level composes through dense
+    # ids 0..nc-1 with all communities nonempty (and it starts as arange).
+    dense_all = comm_all
     return LouvainResult(
         communities=dense_all,
-        # Final reported Q: double-single recompute on the final labels
-        # (the fused loop's own history stays f32).
-        modularity=phase_modularity(dg, np.asarray(labels)) if n_phases
+        # Final reported Q: precise recompute of the final labels on the
+        # LAST working graph (the fused loop's own history stays f32);
+        # multigraph invariance makes it equal to Q on the original graph.
+        modularity=phase_modularity(dg, np.asarray(labels)) if phases
         else -1.0,
         phases=phases,
         total_iterations=tot_iters,

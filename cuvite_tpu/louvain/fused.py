@@ -77,7 +77,9 @@ def _phase_iterations(src, dst, w, vdeg, constant, threshold, lower, *,
     static_argnames=("nv_pad", "max_phases", "accum_dtype", "cycling"),
 )
 def fused_louvain(src, dst, w, thresholds, constant, real_mask, *,
-                  nv_pad, max_phases, accum_dtype=None, cycling=False):
+                  nv_pad, max_phases, accum_dtype=None, cycling=False,
+                  prev_mod0=None, phase_budget=None, phase0=None,
+                  iter_budget=None):
     """Run the full multi-phase Louvain on device.
 
     src/dst: [ne_pad] int32 — local == global ids (single shard), pad
@@ -85,6 +87,14 @@ def fused_louvain(src, dst, w, thresholds, constant, real_mask, *,
     thresholds: [max_phases] per-phase gain thresholds (the cycling
     schedule or a constant).  real_mask: [nv_pad] bool, true for the
     original graph's real vertices.
+
+    ``prev_mod0`` (traced scalar) seeds the cross-phase modularity carry —
+    the multilevel driver passes the previous level's converged value so
+    the first phase here must beat it by the threshold, exactly as if the
+    phases ran in one program.  ``phase_budget`` (traced int) caps how
+    many phases may run without changing the compiled shape; the
+    multilevel driver uses budget=1 to stop after one phase on a
+    still-large graph and compact it on host before continuing.
 
     Returns (labels [nv_pad], modularity, n_phases, total_iters,
     mod_hist [max_phases], iter_hist [max_phases], nc_hist [max_phases]).
@@ -95,6 +105,17 @@ def fused_louvain(src, dst, w, thresholds, constant, real_mask, *,
     iter_hist0 = jnp.zeros(max_phases, dtype=jnp.int32)
     nc_hist0 = jnp.zeros(max_phases, dtype=jnp.int32)
     lower = jnp.asarray(-1.0, dtype=wdt)
+    prev0 = lower if prev_mod0 is None else jnp.asarray(prev_mod0, dtype=wdt)
+    budget = (jnp.int32(max_phases) if phase_budget is None
+              else jnp.asarray(phase_budget, dtype=jnp.int32))
+    # Global phase offset and remaining-iteration budget: traced, so the
+    # multilevel driver's calls share one compiled program while the
+    # `phase < 10` safety-net guard and the cross-phase iteration cap keep
+    # their GLOBAL (whole-run) semantics.
+    ph0 = (jnp.int32(0) if phase0 is None
+           else jnp.asarray(phase0, dtype=jnp.int32))
+    it_budget = (jnp.int32(MAX_TOTAL_ITERATIONS) if iter_budget is None
+                 else jnp.asarray(iter_budget, dtype=jnp.int32))
 
     def count_comms(labels):
         present = jnp.zeros(nv_pad, dtype=jnp.int32).at[
@@ -150,12 +171,11 @@ def fused_louvain(src, dst, w, thresholds, constant, real_mask, *,
             nc_hist)
 
         phase2 = jnp.where(gained, phase + 1, phase)
-        done = (~gained) | (phase2 >= max_phases) \
-            | (tot_iters > MAX_TOTAL_ITERATIONS)
+        done = (~gained) | (phase2 >= budget) | (tot_iters > it_budget)
         return (src2, dst2, w2, labels2, prev_mod2, phase2, tot_iters,
                 mod_hist, iter_hist, nc_hist, gained, done)
 
-    init = (src, dst, w, labels0, lower, jnp.int32(0), jnp.int32(0),
+    init = (src, dst, w, labels0, prev0, jnp.int32(0), jnp.int32(0),
             mod_hist0, iter_hist0, nc_hist0, jnp.bool_(False),
             jnp.bool_(False))
     (src_f, dst_f, w_f, labels, prev_mod, phase, tot_iters,
@@ -168,8 +188,8 @@ def fused_louvain(src, dst, w, thresholds, constant, real_mask, *,
         # or iteration caps after a gaining phase runs no safety pass,
         # matching the per-phase driver.
         th_last = thresholds[jnp.minimum(phase, max_phases - 1)]
-        run_extra = (~last_gained) & (phase < 10) & (th_last > 1e-6) \
-            & (phase < max_phases)
+        run_extra = (~last_gained) & (ph0 + phase < 10) & (th_last > 1e-6) \
+            & (phase < budget)
 
         def extra(args):
             labels, prev_mod, tot_iters, mod_hist, iter_hist, nc_hist, \

@@ -76,3 +76,43 @@ def test_fused_falls_back_for_variants(karate):
     r8 = louvain_phases(karate, engine="fused", nshards=8)
     r1 = louvain_phases(karate, engine="fused")
     assert np.array_equal(r8.communities, r1.communities)
+
+
+def test_fused_multilevel_shrink(monkeypatch):
+    """Above FUSED_SHRINK_EDGES the fused driver compacts the graph between
+    device calls: later phases must report (and traverse) the SHRUNKEN
+    edge counts, and the result must match both the single-call fused run
+    and the bucketed engine."""
+    from cuvite_tpu.io.generate import generate_rgg
+    from cuvite_tpu.louvain import driver
+
+    g = generate_rgg(1024, seed=1)
+    monkeypatch.setattr(driver, "FUSED_SHRINK_EDGES", 64)
+    rf = louvain_phases(g, engine="fused")
+    monkeypatch.setattr(driver, "FUSED_SHRINK_EDGES", 1 << 20)
+    r1 = louvain_phases(g, engine="fused")
+    rb = louvain_phases(g, engine="bucketed")
+    assert rf.modularity == pytest.approx(rb.modularity, abs=1e-5)
+    assert np.array_equal(rf.communities, rb.communities)
+    assert np.array_equal(rf.communities, r1.communities)
+    # The whole point: phase p runs on the COARSENED slab, not the original.
+    ne_hist = [p.num_edges for p in rf.phases]
+    assert len(ne_hist) >= 2 and ne_hist[1] < ne_hist[0]
+    # Single-call fused reports the full slab every phase.
+    assert all(p.num_edges == g.num_edges for p in r1.phases)
+
+
+def test_fused_multilevel_cycling_safety_net(monkeypatch):
+    """FUSED_SHRINK_EDGES=1 makes EVERY call an intermediate (cycling=False)
+    one-phase call, so convergence is always detected on an intermediate
+    call — the safety-net 1e-6 pass must still run (via the forced final
+    cycling call) to match the bucketed cycling schedule."""
+    from cuvite_tpu.io.generate import generate_rgg
+    from cuvite_tpu.louvain import driver
+
+    g = generate_rgg(1024, seed=1)
+    monkeypatch.setattr(driver, "FUSED_SHRINK_EDGES", 1)
+    rf = louvain_phases(g, engine="fused", threshold_cycling=True)
+    rb = louvain_phases(g, engine="bucketed", threshold_cycling=True)
+    assert rf.modularity == pytest.approx(rb.modularity, abs=1e-5)
+    assert np.array_equal(rf.communities, rb.communities)
