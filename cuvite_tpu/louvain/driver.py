@@ -784,32 +784,28 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
     phases: list[PhaseStats] = []
     tot_iters = 0
     prev_mod = -1.0
-    force_final = False
-    while True:
-        with tracer.stage("plan"):
-            dg = DistGraph.build(g, 1, balanced=balanced,
-                                 min_nv_pad=4096, min_ne_pad=16384)
+    dg = None
+    labels = None
+    dense = nc = None
+
+    def _run_call(ths_arr, budget, cyc):
+        """One fused device call on the current (g, dg); folds its phases
+        into the run-level bookkeeping and returns how many it ran."""
+        nonlocal tot_iters, prev_mod, comm_all, labels, dense, nc
         sh = dg.shards[0]
-        remaining = max_p - len(phases)
-        # Big slab: run ONE phase, compact on host, come back.  Small (or
-        # final) slab: let the device program run everything remaining.
-        one_phase_level = (g.num_edges >= FUSED_SHRINK_EDGES
-                           and remaining > 1 and not force_final)
-        budget = 1 if one_phase_level else remaining
+        t_call = time.perf_counter()
         with tracer.stage("iterate"):
             out = fused_louvain(
                 jnp.asarray(np.asarray(sh.src).astype(np.int32)),
                 jnp.asarray(np.asarray(sh.dst).astype(np.int32)),
                 jnp.asarray(np.asarray(sh.w).astype(wdt)),
-                jnp.asarray(_ths(len(phases))),
+                jnp.asarray(ths_arr),
                 constant,
                 jnp.asarray(dg.vertex_mask()),
                 nv_pad=dg.nv_pad,
                 max_phases=max_p,
                 accum_dtype=adt,
-                # Safety-net pass belongs to the LAST call only (the analog
-                # of main.cpp:432-442 running once, after the phase loop).
-                cycling=cycling and not one_phase_level,
+                cycling=cyc,
                 prev_mod0=np.asarray(prev_mod, dtype=wdt),
                 phase_budget=np.int32(budget),
                 phase0=np.int32(len(phases)),
@@ -817,6 +813,7 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
             )
             (labels, loop_mod, n_phases, iters, mod_hist, iter_hist,
              nc_hist) = jax.device_get(out)
+        call_s = time.perf_counter() - t_call
         n_phases = int(n_phases)
         tot_iters += int(iters)
         tracer.count("traversed_edges", g.num_edges * int(iters))
@@ -826,7 +823,7 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
                 phase=len(phases), modularity=float(mod_hist[p]),
                 iterations=int(iter_hist[p]), num_vertices=nv_p,
                 num_edges=g.num_edges,
-                seconds=0.0,  # per-call split below
+                seconds=call_s / n_phases,
             ))
             nv_p = int(nc_hist[p])
             if verbose:
@@ -838,15 +835,36 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
             dense, nc = renumber_communities(comm_lvl)
             comm_all = dense[comm_all]
             prev_mod = float(loop_mod)
+        return n_phases
+
+    while True:
+        with tracer.stage("plan"):
+            dg = DistGraph.build(g, 1, balanced=balanced,
+                                 min_nv_pad=4096, min_ne_pad=16384)
+        remaining = max_p - len(phases)
+        # Big slab: run ONE phase, compact on host, come back.  Small (or
+        # final) slab: let the device program run everything remaining
+        # (incl. the in-program cycling safety net, main.cpp:432-442).
+        one_phase_level = (g.num_edges >= FUSED_SHRINK_EDGES
+                           and remaining > 1)
+        budget = 1 if one_phase_level else remaining
+        n_phases = _run_call(_ths(len(phases)), budget,
+                             cyc=cycling and not one_phase_level)
         if n_phases < budget:
-            # Stopped by no-gain (or the iteration cap).  If that happened
-            # on an intermediate call — which runs with cycling=False — the
-            # 1e-6 safety-net pass hasn't had its chance yet: run one final
-            # call on the SAME graph with the full cycling semantics.
-            if (one_phase_level and cycling and not force_final
+            # Stopped by no-gain (or the iteration cap).  On an
+            # intermediate call the in-program safety net was off; when the
+            # host can see the pass is still eligible (global phase < 10,
+            # cycled threshold above 1e-6, main.cpp:432-442), run JUST the
+            # 1e-6 phase — not a rerun of the converged phase.
+            if (one_phase_level and cycling
+                    and len(phases) < 10
+                    and float(_ths(len(phases))[0]) > 1e-6
                     and tot_iters <= MAX_TOTAL_ITERATIONS):
-                force_final = True
-                continue
+                # The fused body's inner sweep always restarts from
+                # lower=-1 while gain-testing against the carried prev_mod
+                # — exactly the safety-pass semantics, so a plain 1e-6
+                # one-phase call IS the safety net.
+                _run_call(np.full(max_p, 1e-6, dtype=wdt), 1, cyc=False)
             break
         if (len(phases) >= max_p or not one_phase_level
                 or tot_iters > MAX_TOTAL_ITERATIONS):
@@ -855,8 +873,6 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
             g = coarsen_graph(g, dense, nc)
 
     total_s = time.perf_counter() - t_start
-    for st in phases:
-        st.seconds = total_s / max(len(phases), 1)
     # comm_all is already dense: every gaining level composes through dense
     # ids 0..nc-1 with all communities nonempty (and it starts as arange).
     dense_all = comm_all

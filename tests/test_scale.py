@@ -1,0 +1,119 @@
+"""Scale-exercising tests (VERDICT round-1 weak #8).
+
+The small golden graphs never reach the paths that matter at benchmark
+scale: ROW_CHUNK-sized lax.map chunking (a bucket with more rows than one
+chunk), the heavy class on a genuinely skewed graph, several width classes
+populated at once, and the sparse exchange's O(owned + ghosts) footprint.
+These tests build graphs big/skewed enough to hit each, while staying
+CPU-test-sized; a scale-20 smoke is env-gated (CUVITE_SLOW_TESTS=1).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from cuvite_tpu.core.distgraph import DistGraph
+from cuvite_tpu.core.graph import Graph
+from cuvite_tpu.evaluate.modularity import modularity
+from cuvite_tpu.io.generate import generate_rmat
+from cuvite_tpu.louvain.bucketed import ROW_CHUNK, BucketPlan
+from cuvite_tpu.louvain.driver import louvain_phases
+
+
+@pytest.fixture(scope="module")
+def rmat15():
+    return generate_rmat(15, edge_factor=16, seed=5)
+
+
+def test_rmat15_overflows_row_chunk(rmat15):
+    """A scale-15 R-MAT's narrow buckets hold more rows than ROW_CHUNK, so
+    the lax.map chunking path actually executes (no prior test reached
+    it)."""
+    g = rmat15
+    dg = DistGraph.build(g, 1)
+    sh = dg.shards[0]
+    plan = BucketPlan.build(np.asarray(sh.src), np.asarray(sh.dst),
+                            np.asarray(sh.w), nv_local=dg.nv_pad, base=0)
+    rows = {b.width: len(b.verts) for b in plan.buckets}
+    assert any(n > ROW_CHUNK for n in rows.values()), rows
+    # Multiple width classes populated at once.
+    assert len([n for n in rows.values() if n > 0]) >= 4, rows
+
+
+def test_rmat15_bucketed_matches_sort_engine(rmat15):
+    """Full-run equality of the two engines on a graph big enough to
+    exercise chunking and several buckets at once."""
+    rb = louvain_phases(rmat15, engine="bucketed")
+    rs = louvain_phases(rmat15, engine="sort")
+    assert rb.modularity == pytest.approx(rs.modularity, abs=5e-4)
+    q = modularity(rmat15, rb.communities)
+    assert q == pytest.approx(rb.modularity, abs=1e-4)
+    assert q > 0.05  # R-MATs are weakly modular but not structureless
+
+
+@pytest.fixture(scope="module")
+def hub_graph():
+    """Deterministic skewed graph: a hub of degree > 8192 (the heavy
+    class threshold DEFAULT_BUCKETS[-1]) over a ring of cliques."""
+    edges = []
+    nv = 40 * 256 + 1  # 40 cliques of 256 + hub
+    hub = nv - 1
+    for c in range(40):
+        base = c * 256
+        for i in range(256):
+            edges.append((base + i, base + (i + 1) % 256))
+            edges.append((base + i, base + (i + 7) % 256))
+            edges.append((base + i, base + (i + 31) % 256))
+    for v in range(hub):  # hub sees every vertex: degree 10240 > 8192
+        edges.append((hub, v))
+    e = np.array(edges, dtype=np.int64)
+    return Graph.from_edges(nv, e[:, 0], e[:, 1])
+
+
+def test_heavy_class_on_skewed_graph(hub_graph):
+    g = hub_graph
+    assert int(g.degrees().max()) > 8192
+    dg = DistGraph.build(g, 1)
+    sh = dg.shards[0]
+    plan = BucketPlan.build(np.asarray(sh.src), np.asarray(sh.dst),
+                            np.asarray(sh.w), nv_local=dg.nv_pad, base=0)
+    assert plan.has_heavy
+    rb = louvain_phases(g, engine="bucketed")
+    rs = louvain_phases(g, engine="sort")
+    assert rb.modularity == pytest.approx(rs.modularity, abs=5e-4)
+    assert rb.modularity > 0.5  # cliques must be recovered despite the hub
+
+
+def test_heavy_class_multishard(hub_graph):
+    """The heavy path under SPMD + sparse exchange (the hub's edges land in
+    one shard's heavy slab; its tails are ghosts of every other shard)."""
+    r8 = louvain_phases(hub_graph, nshards=8)
+    r1 = louvain_phases(hub_graph, nshards=1)
+    assert np.array_equal(r8.communities, r1.communities)
+
+
+def test_sparse_exchange_footprint_rmat15():
+    """Per-chip sparse-exchange state is O(owned + ghosts), not
+    O(nv_total): the extended table of every shard must stay well below
+    the replicated-exchange footprint."""
+    from cuvite_tpu.comm.exchange import ExchangePlan
+
+    g = generate_rmat(14, edge_factor=8, seed=9)
+    dg = DistGraph.build(g, 8)
+    xplan = ExchangePlan.build(dg)
+    nv_total = dg.total_padded_vertices
+    # Ghost tables are padded to pow2 of the max shard's ghost count; even
+    # so, owned + ghosts must undercut the full vertex space.
+    assert dg.nv_pad + xplan.ghost_pad < nv_total
+    for gids in xplan.ghost_ids:
+        assert len(gids) < nv_total - dg.nv_pad
+
+
+@pytest.mark.skipif(not os.environ.get("CUVITE_SLOW_TESTS"),
+                    reason="scale-20 smoke: set CUVITE_SLOW_TESTS=1")
+def test_scale20_smoke():
+    g = generate_rmat(20, edge_factor=16, seed=1)
+    res = louvain_phases(g, engine="bucketed")
+    assert res.modularity > 0.01
+    assert len(res.phases) >= 2
