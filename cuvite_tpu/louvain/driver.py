@@ -873,6 +873,15 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
             g = coarsen_graph(g, dense, nc)
 
     total_s = time.perf_counter() - t_start
+    # Per-call seconds only cover the device calls; rescale so
+    # sum(p.seconds) == wall time of the whole loop (plan/coarsen host
+    # stages included) — bench.py and the CLI compute TEPS from that sum,
+    # which must stay comparable across engines and rounds.
+    call_sum = sum(st.seconds for st in phases)
+    if call_sum > 0:
+        scale = total_s / call_sum
+        for st in phases:
+            st.seconds *= scale
     # comm_all is already dense: every gaining level composes through dense
     # ids 0..nc-1 with all communities nonempty (and it starts as arange).
     dense_all = comm_all

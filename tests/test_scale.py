@@ -117,3 +117,14 @@ def test_scale20_smoke():
     res = louvain_phases(g, engine="bucketed")
     assert res.modularity > 0.01
     assert len(res.phases) >= 2
+
+
+def test_chunk_for_width_stays_pow2():
+    """Pow2-padded row counts divide evenly only by pow2 chunks; a non-pow2
+    chunk (e.g. from the 384/768 widths) would silently disable chunking
+    and blow the transient-memory bound."""
+    from cuvite_tpu.louvain.bucketed import DEFAULT_BUCKETS, chunk_for_width
+
+    for w in DEFAULT_BUCKETS:
+        c = chunk_for_width(w)
+        assert c > 0 and (c & (c - 1)) == 0, (w, c)
