@@ -323,7 +323,7 @@ def _row_argmax(cmat, wmat, aymat, smat, curr_comm, vdeg_v, eix_v, ax_v,
 
 
 def _row_argmax_sorted(cmat, wmat, aymat, smat, curr_comm, vdeg_v, eix_v,
-                       ax_v, constant, sentinel):
+                       ax_v, constant, sentinel, id_bound=None):
     """Dedup + dQ + argmax for wide rows via a per-row sort.
 
     O(D log^2 D) per row instead of the all-pairs O(D^2): sort each row by
@@ -331,10 +331,32 @@ def _row_argmax_sorted(cmat, wmat, aymat, smat, curr_comm, vdeg_v, eix_v,
     next-leader index (reverse cummin) — all lane-parallel scans.  This is
     the TPU counterpart of the reference's medium/large GPU kernels
     (/root/reference/louvain_cuda.cu:1024-1346).
+
+    When every community id provably fits in ``31 - bits(D)`` bits
+    (``id_bound``, static), the sort runs on ONE packed int32 key
+    ``(c << bits) | slot`` and the payloads follow by take_along_axis —
+    measured 4-5x faster than the multi-operand comparator sort, with
+    bit-identical results (packed keys are unique, so the stable order by
+    (c, slot) equals the stable order by c).
     """
     wdt = wmat.dtype
     D = cmat.shape[1]
-    if smat is not None:
+    bits = (D - 1).bit_length()
+    packable = (
+        id_bound is not None
+        and cmat.dtype == jnp.int32
+        and (int(id_bound) << bits) <= (1 << 31)
+    )
+    if packable:
+        iota = jax.lax.broadcasted_iota(jnp.int32, cmat.shape, 1)
+        k_s = jax.lax.sort((cmat << bits) | iota, dimension=1)
+        slot = k_s & ((1 << bits) - 1)
+        c_s = k_s >> bits
+        w_s = jnp.take_along_axis(wmat, slot, axis=1)
+        ay_s = jnp.take_along_axis(aymat, slot, axis=1)
+        s_s = (jnp.take_along_axis(smat, slot, axis=1)
+               if smat is not None else None)
+    elif smat is not None:
         c_s, w_s, ay_s, s_s = jax.lax.sort(
             (cmat, wmat, aymat, smat), dimension=1, num_keys=1)
     else:
@@ -381,7 +403,7 @@ def _row_argmax_sorted(cmat, wmat, aymat, smat, curr_comm, vdeg_v, eix_v,
 
 
 def _rows_chunked(cmat, w_mat, dst_mat, curr, vdeg_v, eix_v, ax_v,
-                  constant, sentinel, gather_ay, gather_sz):
+                  constant, sentinel, gather_ay, gather_sz, id_bound=None):
     """Dispatch rows to the right dedup variant, chunked with lax.map to
     bound intermediate memory.  ``gather_ay``/``gather_sz`` produce the
     per-slot community degree / size matrices from (dst_chunk, cmat_chunk)
@@ -389,7 +411,7 @@ def _rows_chunked(cmat, w_mat, dst_mat, curr, vdeg_v, eix_v, ax_v,
     at full bucket size (``gather_sz`` may return None in replicated mode)."""
     nb, width = cmat.shape
     kernel = (_row_argmax if width <= QUADRATIC_MAX_WIDTH
-              else _row_argmax_sorted)
+              else functools.partial(_row_argmax_sorted, id_bound=id_bound))
     chunk = chunk_for_width(width)
 
     def run(cm, wm, dm, cu, vd, ei, ax):
@@ -598,7 +620,8 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
         res = _rows_chunked(cmat, w_mat, dst_mat,
                             curr, vdeg_v, jnp.take(eix, safe_v),
                             own_deg(safe_v) - vdeg_v,
-                            constant, sentinel, slot_ay, slot_size)
+                            constant, sentinel, slot_ay, slot_size,
+                            id_bound=nv_total)
         best_c = best_c.at[verts].set(res.best_c, mode="drop")
         best_gain = best_gain.at[verts].set(res.best_gain, mode="drop")
         if use_sparse:
