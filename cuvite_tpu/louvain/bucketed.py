@@ -102,8 +102,13 @@ class BucketPlan:
         d = dst[real].astype(np.int64)
         ww = w[real].astype(np.float64)
         deg = np.bincount(s, minlength=nv_local)
-        order = np.argsort(s, kind="stable")
-        s, d, ww = s[order], d[order], ww[order]
+        # Slabs cut from a CSR arrive row-ordered (DistGraph.build expands
+        # offsets in vertex order), so the O(ne log ne) stable sort is
+        # usually a no-op — skip it after an O(ne) check.  Color-class
+        # plans mask rows to nv_local and DO need the sort.
+        if len(s) and np.any(s[:-1] > s[1:]):
+            order = np.argsort(s, kind="stable")
+            s, d, ww = s[order], d[order], ww[order]
         row_start = np.concatenate([[0], np.cumsum(deg)[:-1]]).astype(np.int64)
 
         self_loop = np.zeros(nv_local, dtype=np.float64)

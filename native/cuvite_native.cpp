@@ -107,17 +107,53 @@ int64_t cv_build_csr(int64_t nv, int64_t ne, const int64_t* src,
     for (uint64_t x = unv > 0 ? unv - 1 : 0; x; x >>= 1) ++vb;
     key_bits = 2 * vb;
   }
-  for (int shift = 0; shift < key_bits; shift += 8) {
-    int64_t hist[257] = {0};
-    for (int64_t j = 0; j < m; ++j) hist[((key[j] >> shift) & 0xFF) + 1]++;
-    for (int b = 0; b < 256; ++b) hist[b + 1] += hist[b];
-    for (int64_t j = 0; j < m; ++j) {
-      int64_t slot = hist[(key[j] >> shift) & 0xFF]++;
-      key2[slot] = key[j];
-      pw2[slot] = pw[j];
+  // Parallel stable LSD radix: per-thread histograms over contiguous input
+  // blocks, digit-major/thread-minor prefix, then each thread scatters its
+  // own block — stability (and thus the exact f64 coalesce order) is
+  // preserved, so output is bit-identical to the serial sort.
+  {
+#if defined(_OPENMP)
+    const int nt = omp_get_max_threads();
+#else
+    const int nt = 1;
+#endif
+    std::vector<int64_t> hist((size_t)nt * 256);
+    const int64_t blk = (m + nt - 1) / (nt > 0 ? nt : 1);
+    for (int shift = 0; shift < key_bits; shift += 8) {
+      std::fill(hist.begin(), hist.end(), 0);
+      // Loop over BLOCK ids (not thread ids): correctness holds for any
+      // actual team size (OMP_DYNAMIC, thread limits, nested regions) —
+      // every block is processed exactly once, whoever runs it.
+#pragma omp parallel for schedule(static)
+      for (int t = 0; t < nt; ++t) {
+        int64_t* h = hist.data() + (size_t)t * 256;
+        const int64_t lo = t * blk, hi = std::min<int64_t>(m, lo + blk);
+        for (int64_t j = lo; j < hi; ++j) h[(key[j] >> shift) & 0xFF]++;
+      }
+      // Exclusive scan, digit-major then block-minor: block t's digit-b
+      // slots start after every block's smaller digits and after earlier
+      // blocks' digit-b entries — preserving LSD stability.
+      int64_t run = 0;
+      for (int b = 0; b < 256; ++b) {
+        for (int t = 0; t < nt; ++t) {
+          int64_t c = hist[(size_t)t * 256 + b];
+          hist[(size_t)t * 256 + b] = run;
+          run += c;
+        }
+      }
+#pragma omp parallel for schedule(static)
+      for (int t = 0; t < nt; ++t) {
+        int64_t* h = hist.data() + (size_t)t * 256;
+        const int64_t lo = t * blk, hi = std::min<int64_t>(m, lo + blk);
+        for (int64_t j = lo; j < hi; ++j) {
+          int64_t slot = h[(key[j] >> shift) & 0xFF]++;
+          key2[slot] = key[j];
+          pw2[slot] = pw[j];
+        }
+      }
+      key.swap(key2);
+      pw.swap(pw2);
     }
-    key.swap(key2);
-    pw.swap(pw2);
   }
 
   // Linear coalesce of the sorted (key, weight) stream into the CSR.
