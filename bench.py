@@ -20,6 +20,8 @@ import os
 import sys
 import time
 
+_T_PROC = time.perf_counter()  # budget accounting starts at process start
+
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_EDGES_PER_SEC_PER_CHIP = 1.0e9 / 64.0
@@ -38,7 +40,7 @@ if not os.environ.get("CUVITE_NO_COMPILE_CACHE"):
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 
-def _init_backend(max_tries: int = 2, timeout_s: int = 90) -> str:
+def _init_backend(max_tries: int = 2, timeout_s: int = 75) -> str:
     """Decide which jax backend this process will use, with a hang guard.
 
     The axon TPU plugin's backend init is flaky in this image: it can raise
@@ -120,28 +122,52 @@ def main():
     # in-memory jit cache and TEPS measures steady-state execution, not
     # XLA compilation (the reference likewise excludes one-time costs from
     # its clustering-time metric, main.cpp:499-518).
+    #
+    # Wall-clock budget (BENCH_TIME_BUDGET seconds, default 420): the
+    # harness running this script enforces its own timeout, and a killed
+    # bench reports NOTHING.  If the warm-up (which eats all compilation)
+    # already used too much of the budget, report the warm-up's own TEPS —
+    # compile-included, flagged as such — instead of risking the timed run
+    # being killed mid-flight.
+    budget_s = float(os.environ.get("BENCH_TIME_BUDGET", "420"))
+    t1 = time.perf_counter()
     res = louvain_phases(graph, engine=engine)
-    del res
+    warm_wall = time.perf_counter() - t1
+    # Elapsed since PROCESS start: backend probes against a wedged TPU
+    # tunnel can eat 150s before main() even begins, and the external
+    # timeout covers all of it.
+    elapsed = time.perf_counter() - _T_PROC
+
+    def emit(res, wall, compile_included):
+        traversed = sum(p.num_edges * p.iterations for p in res.phases)
+        clustering_s = sum(p.seconds for p in res.phases) or wall
+        teps = traversed / clustering_s
+        print(f"# Q={res.modularity:.5f} phases={len(res.phases)} "
+              f"iters={res.total_iterations} clustering={clustering_s:.2f}s "
+              f"wall={wall:.2f}s compile_included={compile_included}",
+              file=sys.stderr)
+        out = {
+            "metric": "louvain_teps_per_chip",
+            "value": round(teps, 1),
+            "unit": "traversed_edges/sec",
+            "vs_baseline": round(teps / BASELINE_EDGES_PER_SEC_PER_CHIP, 4),
+            "platform": platform,
+            "scale": scale,
+        }
+        if compile_included:
+            out["compile_included"] = True
+        print(json.dumps(out))
+
+    if elapsed + 1.5 * warm_wall > budget_s:
+        print(f"# budget: {elapsed:.0f}s elapsed of {budget_s:.0f}s — "
+              f"skipping the steady-state rerun", file=sys.stderr)
+        emit(res, warm_wall, compile_included=True)
+        return
+    del res  # free the warm-up labels (O(nv)) before the timed run
 
     t1 = time.perf_counter()
     res = louvain_phases(graph, engine=engine, verbose=False)
-    wall = time.perf_counter() - t1
-
-    traversed = sum(p.num_edges * p.iterations for p in res.phases)
-    clustering_s = sum(p.seconds for p in res.phases) or wall
-    teps = traversed / clustering_s
-
-    print(f"# Q={res.modularity:.5f} phases={len(res.phases)} "
-          f"iters={res.total_iterations} clustering={clustering_s:.2f}s "
-          f"wall={wall:.2f}s", file=sys.stderr)
-    print(json.dumps({
-        "metric": "louvain_teps_per_chip",
-        "value": round(teps, 1),
-        "unit": "traversed_edges/sec",
-        "vs_baseline": round(teps / BASELINE_EDGES_PER_SEC_PER_CHIP, 4),
-        "platform": platform,
-        "scale": scale,
-    }))
+    emit(res, time.perf_counter() - t1, compile_included=False)
 
 
 if __name__ == "__main__":

@@ -97,6 +97,13 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--engine", default="auto",
                      choices=["auto", "sort", "bucketed", "pallas", "fused"],
                      help="execution engine (auto = degree-bucketed)")
+    run.add_argument("--exchange", default="auto",
+                     choices=["auto", "sparse", "replicated"],
+                     help="SPMD community exchange: 'sparse' = per-phase "
+                          "ghost routing, O(owned+ghosts)/iteration (the "
+                          "fillRemoteCommunities analog); 'replicated' = "
+                          "all_gather of the full community vector; 'auto' "
+                          "picks by graph size per phase")
     run.add_argument("--checkpoint-dir", metavar="DIR",
                      help="save inter-phase state after each phase "
                           "(the reference has no mid-run persistence)")
@@ -241,6 +248,7 @@ def main(argv=None) -> int:
         et_mode=args.early_term or 0,
         et_delta=args.et_delta,
         engine=args.engine,
+        exchange=args.exchange,
         coloring=args.coloring or 0,
         vertex_ordering=args.vertex_ordering or 0,
         verbose=not args.quiet,

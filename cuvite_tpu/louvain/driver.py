@@ -749,6 +749,19 @@ class PhaseRunner:
 # cheaper than extra compiles + transfers.
 FUSED_SHRINK_EDGES = 1 << 20
 
+# exchange='auto' cutover — a MEMORY bound, not a speed crossover: the
+# replicated exchange (all_gather of the full community vector + full-width
+# psums) measured FASTER than the sparse plan at every scale the CPU mesh
+# can hold (scale 20: 82s vs 111s; scale 22: 272s vs 469s, 8 shards), but
+# its per-chip state is O(nv_total): at the v5p-64 north star (padded
+# nv_total ~2^29) that is several multi-GB replicated arrays per chip per
+# iteration — HBM-infeasible, which is exactly why the reference built its
+# sparse protocol (louvain.cpp:2588-3264).  Above this vertex count the
+# driver switches to the sparse O(owned + ghosts) plan; below it the
+# replicated arrays cost at most ~1 GB per chip and the simpler exchange
+# wins.  Re-tune on real multi-chip hardware when available.
+AUTO_SPARSE_MIN_VERTICES = 1 << 26
+
 
 def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
                max_phases, verbose, tracer):
@@ -911,7 +924,7 @@ def louvain_phases(
     engine: str = "auto",
     coloring: int = 0,
     vertex_ordering: int = 0,
-    exchange: str = "sparse",
+    exchange: str = "auto",
     exchange_budget: int | None = None,
     max_phases: int = TERMINATION_PHASE_COUNT,
     verbose: bool = False,
@@ -951,6 +964,8 @@ def louvain_phases(
         if engine not in ("auto", "bucketed"):
             raise ValueError(
                 "per-host ingest supports only the bucketed engine")
+        if exchange == "auto":
+            exchange = "sparse"  # host memory is the constraint here
         if exchange != "sparse":
             raise ValueError("per-host ingest requires exchange='sparse'")
         if coloring or vertex_ordering:
@@ -961,6 +976,10 @@ def louvain_phases(
             raise ValueError(
                 "checkpointing needs the full original graph for its "
                 "content fingerprint; use full ingest")
+    if exchange == "auto" and exchange_budget is not None:
+        # An explicit per-peer budget only means anything on the sparse
+        # plan; honor the caller's intent rather than silently ignoring it.
+        exchange = "sparse"
     if mesh is None and (nshards > 1 or dist_ingest):
         mesh = make_mesh(nshards)
     if engine == "auto":
@@ -1073,6 +1092,13 @@ def louvain_phases(
                 min_nv_pad=max(1, 4096 // nshards),
                 min_ne_pad=max(1, 16384 // nshards),
             )
+        if exchange == "auto":
+            # Per PHASE: coarse phases of a huge graph shrink back under
+            # the cutover and get the cheaper replicated exchange.
+            phase_exchange = ("sparse" if dg.total_padded_vertices
+                              >= AUTO_SPARSE_MIN_VERTICES else "replicated")
+        else:
+            phase_exchange = exchange
         color_dev = None
         n_classes = 0
         if (coloring or vertex_ordering) and phase == 0:
@@ -1120,7 +1146,7 @@ def louvain_phases(
                     with tracer.stage("plan"):
                         runner = PhaseRunner(
                             dg, mesh=mesh, engine=engine,
-                            budget=budget, exchange=exchange,
+                            budget=budget, exchange=phase_exchange,
                             color_local=color_np,
                             n_color_classes=n_classes,
                             ordering=bool(vertex_ordering and not coloring),

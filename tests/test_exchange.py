@@ -113,13 +113,13 @@ def test_tiny_budget_overflows_and_driver_retries(rmat9):
     # communities as the single-shard run.
     r1 = louvain_phases(rmat9, engine="bucketed")
     rN = louvain_phases(rmat9, nshards=nshards, engine="bucketed",
-                        exchange_budget=1)
+                        exchange="sparse", exchange_budget=1)
     assert rN.modularity == pytest.approx(r1.modularity, abs=1e-4)
 
 
 def test_full_run_sparse_rgg_matches_single():
     g = generate_rgg(512, seed=5)
     r1 = louvain_phases(g, engine="bucketed")
-    rN = louvain_phases(g, nshards=8, engine="bucketed")
+    rN = louvain_phases(g, nshards=8, engine="bucketed", exchange="sparse")
     assert rN.modularity == pytest.approx(r1.modularity, abs=1e-4)
     assert rN.num_communities == r1.num_communities

@@ -88,7 +88,7 @@ def test_heavy_class_on_skewed_graph(hub_graph):
 def test_heavy_class_multishard(hub_graph):
     """The heavy path under SPMD + sparse exchange (the hub's edges land in
     one shard's heavy slab; its tails are ghosts of every other shard)."""
-    r8 = louvain_phases(hub_graph, nshards=8)
+    r8 = louvain_phases(hub_graph, nshards=8, exchange="sparse")
     r1 = louvain_phases(hub_graph, nshards=1)
     assert np.array_equal(r8.communities, r1.communities)
 
