@@ -1,0 +1,90 @@
+"""Cross-feature combinations the per-feature suites don't pair up.
+
+The reference exercises its flags jointly (e.g. `-b -t 2 -i` in one run,
+README:54-102); these tests pin the interaction matrix: early termination
+on the sharded engines under both exchanges, the 64-bit policy end to end,
+per-host ingest with ET and balanced cuts, weighted graphs through the
+fused engine, and threshold cycling on a mesh.
+"""
+
+import numpy as np
+import pytest
+
+from cuvite_tpu.core.graph import Graph
+from cuvite_tpu.evaluate.modularity import modularity
+from cuvite_tpu.io.generate import generate_rgg
+from cuvite_tpu.louvain.driver import louvain_phases
+
+
+@pytest.fixture(scope="module")
+def rgg384():
+    return generate_rgg(384, seed=11)
+
+
+@pytest.fixture(scope="module", params=["sparse", "replicated"])
+def plain_by_exchange(request, rgg384):
+    """One plain 4-shard baseline per exchange mode, shared by the ET
+    parametrizations (it only depends on the exchange)."""
+    return request.param, louvain_phases(rgg384, nshards=4,
+                                         exchange=request.param)
+
+
+@pytest.mark.parametrize("et_mode", [1, 2])
+def test_et_multishard_both_exchanges(rgg384, et_mode, plain_by_exchange):
+    """ET freeze/decay masks ride the on-device loop on the SPMD engines
+    under either exchange; quality must stay near the plain run."""
+    exchange, plain = plain_by_exchange
+    r = louvain_phases(rgg384, nshards=4, et_mode=et_mode,
+                       exchange=exchange)
+    assert r.modularity > 0.9 * plain.modularity
+    assert modularity(rgg384, r.communities) == pytest.approx(
+        r.modularity, abs=1e-4)
+
+
+def test_bits64_policy_end_to_end(tmp_path):
+    """wide_policy (int64 ids / f64 weights on host) through write, ranged
+    read, and a sharded run — the USE_32_BIT_GRAPH switch's other half."""
+    from cuvite_tpu.core.types import wide_policy
+    from cuvite_tpu.io.vite import read_vite, write_vite
+
+    g32 = generate_rgg(256, seed=7)
+    g = Graph(offsets=g32.offsets,
+              tails=g32.tails.astype(np.int64),
+              weights=g32.weights.astype(np.float64),
+              policy=wide_policy())
+    p = str(tmp_path / "wide.bin")
+    write_vite(p, g, bits64=True)
+    g2 = read_vite(p, bits64=True)
+    assert g2.policy.vertex_dtype == np.int64
+    r = louvain_phases(g2, nshards=4)
+    r32 = louvain_phases(g32, nshards=4)
+    assert np.array_equal(r.communities, r32.communities)
+
+
+def test_dist_ingest_with_et_and_balanced(tmp_path):
+    from cuvite_tpu.io.dist_ingest import DistVite
+    from cuvite_tpu.io.vite import write_vite
+
+    g = generate_rgg(384, seed=11)
+    p = str(tmp_path / "g.bin")
+    write_vite(p, g)
+    dv = DistVite.load(p, 8, balanced=True)
+    r = louvain_phases(dv, balanced=True, et_mode=1)
+    full = louvain_phases(g, nshards=8, balanced=True, et_mode=1,
+                          exchange="sparse")
+    assert np.array_equal(r.communities, full.communities)
+
+
+def test_fused_weighted_graph(rgg384):
+    """RGG weights are real distances — the fused engine must agree with
+    bucketed on a genuinely weighted graph."""
+    rf = louvain_phases(rgg384, engine="fused")
+    rb = louvain_phases(rgg384, engine="bucketed")
+    assert np.array_equal(rf.communities, rb.communities)
+    assert rf.modularity == pytest.approx(rb.modularity, abs=1e-5)
+
+
+def test_threshold_cycling_multishard(rgg384):
+    r = louvain_phases(rgg384, nshards=8, threshold_cycling=True)
+    r1 = louvain_phases(rgg384, threshold_cycling=True)
+    assert np.array_equal(r.communities, r1.communities)

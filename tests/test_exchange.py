@@ -123,3 +123,29 @@ def test_full_run_sparse_rgg_matches_single():
     rN = louvain_phases(g, nshards=8, engine="bucketed", exchange="sparse")
     assert rN.modularity == pytest.approx(r1.modularity, abs=1e-4)
     assert rN.num_communities == r1.num_communities
+
+
+def test_exchange_auto_cutover(monkeypatch):
+    """exchange='auto' resolves per phase by graph size: both resolutions
+    must produce the same clustering, and the cutover constant must
+    actually switch the path — observed by spying on ExchangePlan.build
+    (only the sparse path constructs a ghost plan)."""
+    from cuvite_tpu.louvain import driver as drv
+
+    plan_builds = []
+    orig_build = ExchangePlan.build
+    monkeypatch.setattr(
+        ExchangePlan, "build",
+        staticmethod(lambda dg: (plan_builds.append(1), orig_build(dg))[1]))
+
+    g = generate_rgg(256, seed=3)
+    monkeypatch.setattr(drv, "AUTO_SPARSE_MIN_VERTICES", 1)
+    r_sparse = louvain_phases(g, nshards=4)      # auto -> sparse everywhere
+    assert plan_builds, "auto below the cutover must build ghost plans"
+    n_sparse_builds = len(plan_builds)
+    monkeypatch.setattr(drv, "AUTO_SPARSE_MIN_VERTICES", 1 << 30)
+    r_repl = louvain_phases(g, nshards=4)        # auto -> replicated
+    assert len(plan_builds) == n_sparse_builds, \
+        "auto above the cutover must not build ghost plans"
+    assert np.array_equal(r_sparse.communities, r_repl.communities)
+    assert r_sparse.modularity == pytest.approx(r_repl.modularity, abs=1e-6)
