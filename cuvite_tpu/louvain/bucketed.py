@@ -47,9 +47,30 @@ from cuvite_tpu.ops import segment as seg
 # the <=128 classes are lane-padded either way and stay cheap.
 DEFAULT_BUCKETS = (8, 16, 32, 64, 128, 256, 384, 512, 768, 1024, 1536,
                    2048, 3072, 4096, 6144, 8192)
-QUADRATIC_MAX_WIDTH = 32   # all-pairs dedup for narrow rows; row-sort above
-ROW_CHUNK = 8192   # rows per lax.map step to bound [chunk, D, D]
-ROW_ELEMS_CHUNK = 1 << 22  # rows*width per lax.map step for sorted dedup
+
+
+def _env_int(name: str, default: int) -> int:
+    import os
+
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+# Dedup-kernel cutover (env-tunable for on-chip A/B): rows of width <=
+# QUADRATIC_MAX_WIDTH dedup by the all-pairs compare (VPU/MXU-friendly
+# O(D^2) with zero sorts/scans/gathers); wider rows take the packed
+# per-row sort.  The crossover is hardware-dependent — the TPU vector
+# units tolerate much larger D^2 than a scalar CPU does — so it is a
+# load-time knob rather than a constant.
+QUADRATIC_MAX_WIDTH = _env_int("CUVITE_QUAD_MAX", 32)
+ROW_CHUNK = _env_int("CUVITE_ROW_CHUNK", 8192)  # rows/lax.map step (quad)
+# rows*width per lax.map step for the sorted dedup classes:
+ROW_ELEMS_CHUNK = _env_int("CUVITE_ROW_ELEMS", 1 << 22)
+# rows*width^2 bound for quad classes wider than the default 32 (the eq
+# matrix is the transient that matters there):
+ROW_QUAD_ELEMS_CHUNK = _env_int("CUVITE_QUAD_ELEMS", 1 << 26)
 
 
 def chunk_for_width(width: int) -> int:
@@ -59,10 +80,18 @@ def chunk_for_width(width: int) -> int:
     rows divide evenly only by pow2 chunks (a non-pow2 chunk — e.g. from
     the 384/768/... widths — would make every large bucket fall back to
     the unchunked path and blow the transient-memory bound)."""
+    def pow2_floor(c: int) -> int:
+        c = max(c, 1)
+        return 1 << (c.bit_length() - 1)
+
     if width <= QUADRATIC_MAX_WIDTH:
-        return ROW_CHUNK
-    c = max(ROW_ELEMS_CHUNK // width, 1)
-    return 1 << (c.bit_length() - 1)
+        # Quad classes: the [chunk, D, D] eq matrix is the transient that
+        # matters — bound rows*D^2, capped by the fixed row-count knob.
+        # (For the default widths <= 32 the row-count cap always wins, so
+        # this reproduces the historical ROW_CHUNK=8192 chunks exactly.)
+        return min(pow2_floor(ROW_CHUNK),
+                   pow2_floor(ROW_QUAD_ELEMS_CHUNK // (width * width)))
+    return pow2_floor(ROW_ELEMS_CHUNK // width)
 
 
 @dataclasses.dataclass

@@ -20,6 +20,25 @@ if "--xla_force_host_platform_device_count" not in _flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Late in a full-suite run, an XLA:CPU compile can segfault inside LLVM
+# (reproduced thrice at the same test when run after the whole suite; never
+# in isolation or with half-suite prefixes).  Primary mitigation is process
+# splitting (pytest.ini: -n 2).  Belt-and-braces: raise the stack soft
+# limit to a large FINITE value before jax loads — glibc sizes new pthread
+# stacks from the soft limit (RLIM_INFINITY would fall back to the 8 MiB
+# default), so XLA's compile worker threads get headroom too.
+import resource  # noqa: E402
+
+_s_soft, _s_hard = resource.getrlimit(resource.RLIMIT_STACK)
+_s_want = 512 << 20
+# RLIM_INFINITY also needs the finite value: glibc sizes pthread stacks
+# from the soft limit only when it is finite (infinity -> 8 MiB default).
+if _s_soft == resource.RLIM_INFINITY or _s_soft < _s_want:
+    try:
+        resource.setrlimit(resource.RLIMIT_STACK, (_s_want, _s_hard))
+    except (ValueError, OSError):  # hard limit lower: best effort
+        pass
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

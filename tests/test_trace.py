@@ -72,7 +72,11 @@ def test_shard_diag_files(tmp_path, karate):
     """--diag-prefix writes one file per shard, a line per phase (the
     reference's dat.out.<rank>, main.cpp:101-110)."""
     prefix = str(tmp_path / "diag" / "dat.out")
-    res = louvain_phases(karate, nshards=4, diag_prefix=prefix)
+    # exchange='sparse' explicitly: ghost counts only exist on the sparse
+    # plan, and 'auto' routes a karate-sized graph to the replicated
+    # exchange (no ghost plan to report).
+    res = louvain_phases(karate, nshards=4, diag_prefix=prefix,
+                         exchange="sparse")
     assert res.modularity > 0.40
     for s in range(4):
         lines = open(f"{prefix}.{s}").read().splitlines()
