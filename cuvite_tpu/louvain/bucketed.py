@@ -212,6 +212,7 @@ class StackedPlan:
     buckets: list            # list of (verts [S*Nb], dst [S*Nb, D], w [S*Nb, D])
     heavy: tuple             # (src [S*H], dst [S*H], w [S*H])
     self_loop: np.ndarray    # [S*nv_pad]
+    perm: np.ndarray         # [S*nv_pad] per-shard assembly permutation
 
 
 def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
@@ -295,11 +296,40 @@ def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
         hdst[r, : len(p.heavy_dst)] = p.heavy_dst
         hw[r, : len(p.heavy_w)] = p.heavy_w
     self_loop = np.concatenate([p.self_loop for p in plans])
+    # Per-shard assembly permutation over the COMMON padded layout (every
+    # shard's concat space has identical extent, so one [nv_pad] perm per
+    # shard row, stacked like the other plan arrays).
+    perm = np.stack([
+        build_assemble_perm([sb[0].reshape(n_rows, -1)[r]
+                             for sb in stacked_buckets], nvl)
+        for r in range(n_rows)
+    ]) if n_rows else np.zeros((0, nvl), dtype=np.int32)
     return StackedPlan(
         buckets=stacked_buckets,
         heavy=(hsrc.reshape(-1), hdst.reshape(-1), hw.reshape(-1)),
         self_loop=self_loop,
+        perm=perm.reshape(-1),
     )
+
+
+def build_assemble_perm(verts_list, nv_local: int) -> np.ndarray:
+    """Vertex -> position in the concatenated bucket-row space.
+
+    ``verts_list``: the PADDED per-bucket vertex arrays exactly as uploaded
+    (padding entries hold >= nv_local and are skipped).  Vertices in no
+    bucket (heavy / degree-0) map to the trailing default slot.  Bucket
+    membership is disjoint, so the map is a pure (partial) permutation —
+    this is what lets the step assemble results with gathers instead of
+    scatters."""
+    total = sum(len(v) for v in verts_list)
+    perm = np.full(nv_local, total, dtype=np.int32)
+    off = 0
+    for v in verts_list:
+        v = np.asarray(v)
+        real = np.nonzero(v < nv_local)[0]
+        perm[v[real]] = (off + real).astype(np.int32)
+        off += len(v)
+    return perm
 
 
 class RowResult(NamedTuple):
@@ -309,13 +339,15 @@ class RowResult(NamedTuple):
     best_size: jax.Array | None  # [Nb] size of best community (sparse mode)
 
 
-def _row_argmax(cmat, wmat, aymat, smat, curr_comm, vdeg_v, eix_v, ax_v,
+def _row_argmax(cmat, wmat, aymat, smat, curr_comm, vdeg_v, sl_v, ax_v,
                 constant, sentinel):
     """Dedup + dQ + argmax for one chunk of bucket rows.
 
     cmat [T, D] neighbor communities; wmat [T, D] weights; aymat [T, D] the
     candidate community's degree a_y per slot; smat [T, D] (or None) the
-    candidate community's size per slot; ax_v [T] = a_x = deg(curr) - k_i.
+    candidate community's size per slot; sl_v [T] the vertex's self-loop
+    weight (e_ix = counter0 - sl is row-local: every edge of a bucket
+    vertex lives in its row); ax_v [T] = a_x = deg(curr) - k_i.
     Replicates distGetMaxIndex (/root/reference/louvain.cpp:2185-2244):
     gain = 2*(e_iy - e_ix) - 2*k_i*(a_y - a_x)/2m, ties to smaller id.
     """
@@ -329,6 +361,7 @@ def _row_argmax(cmat, wmat, aymat, smat, curr_comm, vdeg_v, eix_v, ax_v,
     dup = jnp.any(eq & tri[None, :, :], axis=2)
     is_cc = cmat == curr_comm[:, None]
     counter0 = jnp.sum(jnp.where(is_cc, wmat, 0.0), axis=1)
+    eix_v = counter0 - sl_v
     # No w>0 filter: zero-weight edges are candidates exactly as in the sort
     # engine.  Padding slots are safe without it — they point at the row's
     # own vertex, whose community always equals curr_comm, so is_cc masks
@@ -356,7 +389,7 @@ def _row_argmax(cmat, wmat, aymat, smat, curr_comm, vdeg_v, eix_v, ax_v,
                      best_size=best_size)
 
 
-def _row_argmax_sorted(cmat, wmat, aymat, smat, curr_comm, vdeg_v, eix_v,
+def _row_argmax_sorted(cmat, wmat, aymat, smat, curr_comm, vdeg_v, sl_v,
                        ax_v, constant, sentinel, id_bound=None):
     """Dedup + dQ + argmax for wide rows via a per-row sort.
 
@@ -375,6 +408,12 @@ def _row_argmax_sorted(cmat, wmat, aymat, smat, curr_comm, vdeg_v, eix_v,
     """
     wdt = wmat.dtype
     D = cmat.shape[1]
+    # counter0 in UNSORTED slot order (the historical outer-pass order, so
+    # modularity and e_ix stay bit-identical to the two-pass formulation).
+    counter0 = jnp.sum(
+        jnp.where(cmat == curr_comm[:, None], wmat, 0.0), axis=1
+    ).astype(wdt)
+    eix_v = counter0 - sl_v
     bits = (D - 1).bit_length()
     packable = (
         id_bound is not None
@@ -413,7 +452,6 @@ def _row_argmax_sorted(cmat, wmat, aymat, smat, curr_comm, vdeg_v, eix_v,
     run_sum = suf - jnp.take_along_axis(suf_ext, nxt, axis=1)
 
     is_cc = c_s == curr_comm[:, None]
-    counter0 = jnp.sum(jnp.where(is_cc, w_s, 0.0), axis=1).astype(wdt)
     # No w>0 filter — see _row_argmax; padding self-slots are is_cc-masked.
     valid = leader & (~is_cc)
 
@@ -436,7 +474,7 @@ def _row_argmax_sorted(cmat, wmat, aymat, smat, curr_comm, vdeg_v, eix_v,
                      best_size=best_size)
 
 
-def _rows_chunked(cmat, w_mat, dst_mat, curr, vdeg_v, eix_v, ax_v,
+def _rows_chunked(cmat, w_mat, dst_mat, curr, vdeg_v, sl_v, ax_v,
                   constant, sentinel, gather_ay, gather_sz, id_bound=None):
     """Dispatch rows to the right dedup variant, chunked with lax.map to
     bound intermediate memory.  ``gather_ay``/``gather_sz`` produce the
@@ -448,12 +486,12 @@ def _rows_chunked(cmat, w_mat, dst_mat, curr, vdeg_v, eix_v, ax_v,
               else functools.partial(_row_argmax_sorted, id_bound=id_bound))
     chunk = chunk_for_width(width)
 
-    def run(cm, wm, dm, cu, vd, ei, ax):
+    def run(cm, wm, dm, cu, vd, sl, ax):
         return kernel(cm, wm, gather_ay(dm, cm), gather_sz(dm, cm),
-                      cu, vd, ei, ax, constant, sentinel)
+                      cu, vd, sl, ax, constant, sentinel)
 
     if nb <= chunk or nb % chunk != 0:
-        return run(cmat, w_mat, dst_mat, curr, vdeg_v, eix_v, ax_v)
+        return run(cmat, w_mat, dst_mat, curr, vdeg_v, sl_v, ax_v)
     nchunk = nb // chunk
 
     res = jax.lax.map(
@@ -464,7 +502,7 @@ def _rows_chunked(cmat, w_mat, dst_mat, curr, vdeg_v, eix_v, ax_v,
             dst_mat.reshape(nchunk, chunk, -1),
             curr.reshape(nchunk, chunk),
             vdeg_v.reshape(nchunk, chunk),
-            eix_v.reshape(nchunk, chunk),
+            sl_v.reshape(nchunk, chunk),
             ax_v.reshape(nchunk, chunk),
         ),
     )
@@ -510,8 +548,15 @@ def bucketed_modularity(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
 def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
                   constant, *, nv_total, sentinel, accum_dtype=None,
                   axis_name=None, pallas_flags=(), pallas_interpret=False,
-                  sparse_plan=None, nshards=1, budget=0, info_comm=None):
+                  sparse_plan=None, nshards=1, budget=0, info_comm=None,
+                  assemble_perm=None):
     """Full Louvain sweep over one shard using the bucketed engine.
+
+    ``assemble_perm`` (phase-static [nv_local] int32, vertex -> index into
+    the bucket-row concat space, trailing index = "in no bucket"): enables
+    the scatter-free assembly of per-vertex results — TPU scatters are
+    serialization hazards; a static permutation gather is not.  Semantics
+    are identical with or without it.
 
     ``bucket_arrays`` is a tuple of (verts, dst_mat, w_mat) triples (one per
     degree class); ``heavy_arrays`` is (src, dst, w) for the residual
@@ -593,18 +638,9 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
         return (jnp.take(env.cdeg_v, v_safe) if use_sparse
                 else jnp.take(comm_deg, jnp.take(comm, v_safe)))
 
-    # Per-vertex weight into the current community (incl. self-loops) comes
-    # out of the bucket pass; start from zero and accumulate per class.
-    counter0 = jnp.zeros((nv_local,), dtype=wdt)
-    best_c = jnp.full((nv_local,), sentinel, dtype=vdt)
     neg_inf = jnp.array(-jnp.inf, dtype=wdt)
-    best_gain = jnp.full((nv_local,), neg_inf, dtype=wdt)
-    best_size = jnp.zeros((nv_local,), dtype=vdt) if use_sparse else None
 
-    # eix depends on counter0 which the buckets themselves produce; the gain
-    # formula needs it per ROW, so compute counter0 first (cheap masked sums)
-    # then run the argmax passes.  For bucket rows counter0 is row-local;
-    # compute it inline per bucket and assemble.
+    # Heavy-vertex current-community weight (also their e_ix source).
     hs, hd, hw = heavy_arrays
     ckey_h = jnp.take(comm_ref, hd)
     csrc_h = jnp.take(comm, jnp.minimum(hs, nv_local - 1))
@@ -612,16 +648,13 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
         jnp.where(ckey_h == csrc_h, hw, jnp.zeros_like(hw)), hs,
         num_segments=nv_local,
     )
-    counter0 = counter0 + c0_heavy
-    # bucket counter0 values are produced by the row pass below.
 
-    # Pallas-routed buckets are self-contained (eix is row-local: the
-    # kernel derives it from its own counter0 and the self-loop weight), so
-    # they finalize in one pass; XLA buckets keep the two-pass structure
-    # (counter0 for all rows first, then argmax with eix).
+    # One pass per bucket: e_ix is row-local (every edge of a bucket vertex
+    # lives in its row), so dedup + counter0 + gain + argmax all happen in a
+    # single kernel over each bucket — no global counter0 prepass.
     is_pallas = (list(pallas_flags) if pallas_flags
                  else [False] * len(bucket_arrays))
-    row_results = []
+    parts = []   # (verts, best_c, best_gain, counter0, best_size|None)
     for i, (verts, dst_mat, w_mat) in enumerate(bucket_arrays):
         safe_v = jnp.minimum(verts, nv_local - 1)
         curr = jnp.take(comm, safe_v)
@@ -636,30 +669,50 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
                 jnp.take(comm_deg, curr) - vdeg_v, constant,
                 sentinel=sentinel, interpret=pallas_interpret,
             )
-            counter0 = counter0.at[verts].add(c0_rows, mode="drop")
-            best_c = best_c.at[verts].set(bc.astype(vdt), mode="drop")
-            best_gain = best_gain.at[verts].set(bg, mode="drop")
+            parts.append((verts, bc.astype(vdt), bg, c0_rows, None))
             continue
         cmat = jnp.take(comm_ref, dst_mat)
-        c0_rows = jnp.sum(
-            jnp.where(cmat == curr[:, None], w_mat, 0.0), axis=1
-        ).astype(wdt)
-        counter0 = counter0.at[verts].add(c0_rows, mode="drop")
-        row_results.append((verts, dst_mat, cmat, w_mat, curr))
-    eix = counter0 - self_loop
-
-    for verts, dst_mat, cmat, w_mat, curr in row_results:
-        safe_v = jnp.minimum(verts, nv_local - 1)
         vdeg_v = jnp.take(vdeg, safe_v)
         res = _rows_chunked(cmat, w_mat, dst_mat,
-                            curr, vdeg_v, jnp.take(eix, safe_v),
+                            curr, vdeg_v, jnp.take(self_loop, safe_v),
                             own_deg(safe_v) - vdeg_v,
                             constant, sentinel, slot_ay, slot_size,
                             id_bound=nv_total)
-        best_c = best_c.at[verts].set(res.best_c, mode="drop")
-        best_gain = best_gain.at[verts].set(res.best_gain, mode="drop")
+        parts.append((verts, res.best_c, res.best_gain, res.counter0,
+                      res.best_size))
+
+    # Assemble per-vertex results from the per-bucket row vectors.  Bucket
+    # membership is phase-static and disjoint, so with ``assemble_perm``
+    # (vertex -> position in the concatenated row space; the trailing slot
+    # holds the no-bucket default) assembly is three pure gathers — the
+    # scatter-free path.  Without a perm (class-restricted plans) fall back
+    # to scatters.
+    if assemble_perm is not None and parts:
+        cat = lambda xs, d: jnp.concatenate(xs + [d])  # noqa: E731
+        d1 = lambda v, dt: jnp.full((1,), v, dtype=dt)  # noqa: E731
+        best_c = jnp.take(
+            cat([p[1] for p in parts], d1(sentinel, vdt)), assemble_perm)
+        best_gain = jnp.take(
+            cat([p[2] for p in parts], neg_inf[None]), assemble_perm)
+        counter0 = c0_heavy + jnp.take(
+            cat([p[3] for p in parts], d1(0, wdt)), assemble_perm)
         if use_sparse:
-            best_size = best_size.at[verts].set(res.best_size, mode="drop")
+            best_size = jnp.take(
+                cat([p[4] for p in parts], d1(0, vdt)), assemble_perm)
+        else:
+            best_size = None
+    else:
+        best_c = jnp.full((nv_local,), sentinel, dtype=vdt)
+        best_gain = jnp.full((nv_local,), neg_inf, dtype=wdt)
+        counter0 = c0_heavy
+        best_size = jnp.zeros((nv_local,), dtype=vdt) if use_sparse else None
+        for verts, bc, bg, c0, bs in parts:
+            best_c = best_c.at[verts].set(bc, mode="drop")
+            best_gain = best_gain.at[verts].set(bg, mode="drop")
+            counter0 = counter0.at[verts].add(c0, mode="drop")
+            if use_sparse and bs is not None:
+                best_size = best_size.at[verts].set(bs, mode="drop")
+    eix = counter0 - self_loop
 
     # ---- heavy vertices: sort-based candidates on their edges only -------
     if use_sparse:
@@ -730,7 +783,8 @@ def make_sharded_bucketed_step(mesh, axis_name: str, n_buckets: int,
     bspec = tuple((P(axis_name), P(axis_name), P(axis_name))
                   for _ in range(n_buckets))
     hspec = (P(axis_name), P(axis_name), P(axis_name))
-    in_specs = [bspec, hspec, P(axis_name), P(axis_name), P(axis_name), P()]
+    in_specs = [bspec, hspec, P(axis_name), P(axis_name), P(axis_name), P(),
+                P(axis_name)]
     out_specs = (P(axis_name), P(), P(), P())
     if sparse is not None:
         nshards, budget = sparse
@@ -746,13 +800,14 @@ def make_sharded_bucketed_step(mesh, axis_name: str, n_buckets: int,
         check_vma=False,
     )
     def step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant,
-             *plan):
+             perm, *plan):
         return bucketed_step(
             bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant,
             nv_total=nv_total, sentinel=sentinel, accum_dtype=accum_dtype,
             axis_name=axis_name,
             sparse_plan=plan if plan else None,
             nshards=nshards, budget=budget,
+            assemble_perm=perm,
         )
 
     return jax.jit(step)

@@ -42,6 +42,7 @@ from cuvite_tpu.louvain.bucketed import (
     QUADRATIC_MAX_WIDTH,
     BucketPlan,
     bucketed_step,
+    build_assemble_perm,
     build_stacked_plans,
     make_sharded_bucketed_step,
 )
@@ -135,12 +136,12 @@ def _get_step(mesh, nv_total: int, accum_dtype) -> object:
                      "pallas_interpret"),
 )
 def _bucketed_jit(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
-                  constant, *, nv_total, sentinel, accum_dtype,
-                  pallas_flags=(), pallas_interpret=False):
+                  constant, assemble_perm=None, *, nv_total, sentinel,
+                  accum_dtype, pallas_flags=(), pallas_interpret=False):
     call = _bucketed_call(nv_total, sentinel, accum_dtype, pallas_flags,
                           pallas_interpret)
     return call(comm, (bucket_arrays, heavy_arrays, self_loop, vdeg,
-                       constant))
+                       constant, assemble_perm))
 
 
 @functools.partial(
@@ -278,11 +279,12 @@ def _run_phase_loop_et(extra, comm0, threshold, lower, active0, et_delta,
 def _bucketed_call(nv_total, sentinel, accum_dtype, pallas_flags=(),
                    pallas_interpret=False):
     def call(comm, extra):
-        buckets, heavy, self_loop, vdeg, constant = extra
+        buckets, heavy, self_loop, vdeg, constant, perm = extra
         return bucketed_step(
             buckets, heavy, self_loop, comm, vdeg, constant,
             nv_total=nv_total, sentinel=sentinel, accum_dtype=accum_dtype,
             pallas_flags=pallas_flags, pallas_interpret=pallas_interpret,
+            assemble_perm=perm,
         )
 
     return call
@@ -291,9 +293,9 @@ def _bucketed_call(nv_total, sentinel, accum_dtype, pallas_flags=(),
 @functools.lru_cache(maxsize=None)
 def _bucketed_sharded_call(step_fn):
     def call(comm, extra):
-        buckets, heavy, self_loop, vdeg, constant, *plan = extra
+        buckets, heavy, self_loop, vdeg, constant, perm, *plan = extra
         return step_fn(buckets, heavy, self_loop, comm, vdeg, constant,
-                       *plan)
+                       perm, *plan)
 
     return call
 
@@ -418,6 +420,7 @@ class PhaseRunner:
                 for a, t in zip(plan.heavy, (vdt, vdt, wdt))
             )
             self_loop = _place(plan.self_loop.astype(wdt))
+            perm_dev = _place(plan.perm)
             step_fn = _STEP_CACHE.get(key)
             if step_fn is None:
                 step_fn = make_sharded_bucketed_step(
@@ -431,11 +434,12 @@ class PhaseRunner:
 
             def _step(src_, dst_, w_, comm, vdeg_, constant):
                 return step_fn(buckets, heavy, self_loop, comm, vdeg_,
-                               constant, *plan_args)
+                               constant, perm_dev, *plan_args)
 
             self._step = _step
             self._call = _bucketed_sharded_call(step_fn)
-            self._bucket_extra = (buckets, heavy, self_loop) + plan_args
+            self._bucket_extra = (buckets, heavy, self_loop,
+                                  perm_dev) + plan_args
             self.src = self.dst = self.w = None
         elif engine in ("bucketed", "pallas"):
             # The bucket matrices replace the edge slab entirely: don't
@@ -449,6 +453,7 @@ class PhaseRunner:
             use_pallas = engine == "pallas"
             buckets = []
             flags = []
+            verts_np = []   # padded host verts, for the assembly perm
             for b in plan.buckets:
                 if use_pallas and b.width <= QUADRATIC_MAX_WIDTH:
                     # Kernel layout: transposed [D, Nb], Nb a multiple of
@@ -469,11 +474,13 @@ class PhaseRunner:
                             wmat.T.astype(wdt))),
                     ))
                     flags.append(True)
+                    verts_np.append(verts)
                 else:
                     buckets.append((jnp.asarray(b.verts.astype(vdt)),
                                     jnp.asarray(b.dst.astype(vdt)),
                                     jnp.asarray(b.w.astype(wdt))))
                     flags.append(False)
+                    verts_np.append(b.verts)
             buckets = tuple(buckets)
             flags = tuple(flags)
             interp = jax.default_backend() != "tpu"
@@ -481,11 +488,14 @@ class PhaseRunner:
                      jnp.asarray(plan.heavy_dst.astype(vdt)),
                      jnp.asarray(plan.heavy_w.astype(wdt)))
             self_loop = jnp.asarray(plan.self_loop.astype(wdt))
+            perm_dev = jnp.asarray(
+                build_assemble_perm(verts_np, dg.nv_pad))
             adt_np = np.dtype(adt).name
 
             def _step(src_, dst_, w_, comm, vdeg_, constant):
                 return _bucketed_jit(
                     buckets, heavy, self_loop, comm, vdeg_, constant,
+                    perm_dev,
                     nv_total=nv_total, sentinel=sentinel, accum_dtype=adt_np,
                     pallas_flags=flags, pallas_interpret=interp,
                 )
@@ -493,7 +503,7 @@ class PhaseRunner:
             self._step = _step
             self._call = _bucketed_call(nv_total, sentinel, adt_np, flags,
                                         interp)
-            self._bucket_extra = (buckets, heavy, self_loop)
+            self._bucket_extra = (buckets, heavy, self_loop, perm_dev)
             self.src = self.dst = self.w = None
             if color_local is not None and n_color_classes > 0:
                 # Per-class bucket plans: each color class's sweep touches

@@ -37,16 +37,15 @@ def test_row_argmax_pallas_matches_xla(width, seed):
     cmat, wmat, curr, vdeg, sl, comm_deg, constant = _bucket_case(
         n_rows, width, nv, seed)
 
-    # Reference path mirrors bucketed_step: counter0 first, then eix =
-    # counter0 - self_loop feeds the argmax.
+    # Reference path mirrors bucketed_step: both kernels take the self-loop
+    # weight and derive eix = counter0 - sl row-locally.
     is_cc = cmat == curr[:, None]
     counter0 = np.sum(np.where(is_cc, wmat, 0.0), axis=1).astype(np.float32)
-    eix = counter0 - sl
     ay = comm_deg[cmat]                     # pre-gathered outside the kernel
     ax = comm_deg[curr] - vdeg
     ref = _row_argmax(
         jnp.asarray(cmat), jnp.asarray(wmat), jnp.asarray(ay), None,
-        jnp.asarray(curr), jnp.asarray(vdeg), jnp.asarray(eix),
+        jnp.asarray(curr), jnp.asarray(vdeg), jnp.asarray(sl),
         jnp.asarray(ax), jnp.asarray(constant), SENTINEL,
     )
     bc, bg, c0 = row_argmax_pallas(
