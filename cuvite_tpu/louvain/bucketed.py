@@ -213,6 +213,7 @@ class StackedPlan:
     heavy: tuple             # (src [S*H], dst [S*H], w [S*H])
     self_loop: np.ndarray    # [S*nv_pad]
     perm: np.ndarray         # [S*nv_pad] per-shard assembly permutation
+    unit_weights: np.ndarray  # [n_buckets] bool: w is {0,1} on EVERY host
 
 
 def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
@@ -304,12 +305,36 @@ def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
                              for sb in stacked_buckets], nvl)
         for r in range(n_rows)
     ]) if n_rows else np.zeros((0, nvl), dtype=np.int32)
+    # Per-bucket unit-weight flags (uint8 upload eligibility) must agree on
+    # every process under per-host ingest — a weighted shard on one host
+    # and an all-padding block on another would otherwise build the same
+    # global array with different dtypes.  Min-allreduce the local verdicts
+    # (min == negated max).
+    unit = np.array([np.all((sb[2] == 0) | (sb[2] == 1))
+                     for sb in stacked_buckets], dtype=np.int64)
+    if local_only:
+        from cuvite_tpu.comm.multihost import allreduce_max_host
+
+        unit = -allreduce_max_host(-unit)
     return StackedPlan(
         buckets=stacked_buckets,
         heavy=(hsrc.reshape(-1), hdst.reshape(-1), hw.reshape(-1)),
         self_loop=self_loop,
         perm=perm.reshape(-1),
+        unit_weights=unit.astype(bool),
     )
+
+
+def compress_unit_weights(w: np.ndarray, wdt) -> np.ndarray:
+    """Return ``w`` as uint8 when every entry is exactly 0 or 1 (unit-weight
+    graphs: real edges weigh 1, padding 0), else as ``wdt``.
+
+    uint8 bucket weights cost 4x less host->device upload and 4x less HBM
+    read per iteration; the step casts back to the weight dtype on use
+    (fused by XLA), and 0/1 cast exactly, so results are bit-identical."""
+    if w.size and np.all((w == 0) | (w == 1)):
+        return w.astype(np.uint8)
+    return w.astype(wdt)
 
 
 def build_assemble_perm(verts_list, nv_local: int) -> np.ndarray:
@@ -534,6 +559,8 @@ def bucketed_modularity(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
         num_segments=nv_local,
     )
     for verts, dst_mat, w_mat in bucket_arrays:
+        if w_mat.dtype != wdt:   # uint8-compressed unit weights
+            w_mat = w_mat.astype(wdt)
         safe_v = jnp.minimum(verts, nv_local - 1)
         curr = jnp.take(comm, safe_v)
         cmat = jnp.take(comm, dst_mat)
@@ -656,6 +683,8 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
                  else [False] * len(bucket_arrays))
     parts = []   # (verts, best_c, best_gain, counter0, best_size|None)
     for i, (verts, dst_mat, w_mat) in enumerate(bucket_arrays):
+        if w_mat.dtype != wdt:   # uint8-compressed unit weights
+            w_mat = w_mat.astype(wdt)
         safe_v = jnp.minimum(verts, nv_local - 1)
         curr = jnp.take(comm, safe_v)
         if is_pallas[i]:

@@ -44,6 +44,7 @@ from cuvite_tpu.louvain.bucketed import (
     bucketed_step,
     build_assemble_perm,
     build_stacked_plans,
+    compress_unit_weights,
     make_sharded_bucketed_step,
 )
 from cuvite_tpu.louvain.precise import phase_modularity
@@ -412,8 +413,10 @@ class PhaseRunner:
             buckets = tuple(
                 (_place(v.astype(vdt)),
                  _place(d.astype(vdt)),
-                 _place(ww.astype(wdt)))
-                for v, d, ww in plan.buckets
+                 # dtype agreed across hosts via the plan's allreduced
+                 # unit-weight flags (NOT a per-process decision).
+                 _place(ww.astype(np.uint8 if plan.unit_weights[i] else wdt)))
+                for i, (v, d, ww) in enumerate(plan.buckets)
             )
             heavy = tuple(
                 _place(a.astype(t))
@@ -478,7 +481,8 @@ class PhaseRunner:
                 else:
                     buckets.append((jnp.asarray(b.verts.astype(vdt)),
                                     jnp.asarray(b.dst.astype(vdt)),
-                                    jnp.asarray(b.w.astype(wdt))))
+                                    jnp.asarray(
+                                        compress_unit_weights(b.w, wdt))))
                     flags.append(False)
                     verts_np.append(b.verts)
             buckets = tuple(buckets)
