@@ -310,8 +310,8 @@ def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
     # and an all-padding block on another would otherwise build the same
     # global array with different dtypes.  Min-allreduce the local verdicts
     # (min == negated max).
-    unit = np.array([np.all((sb[2] == 0) | (sb[2] == 1))
-                     for sb in stacked_buckets], dtype=np.int64)
+    unit = np.array([is_unit_weights(sb[2]) for sb in stacked_buckets],
+                    dtype=np.int64)
     if local_only:
         from cuvite_tpu.comm.multihost import allreduce_max_host
 
@@ -325,14 +325,20 @@ def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
     )
 
 
+def is_unit_weights(w: np.ndarray) -> bool:
+    """True when every entry is exactly 0 or 1 (unit-weight graphs: real
+    edges weigh 1, padding 0) — the single source of the uint8-compression
+    eligibility rule, shared by the single-shard and stacked paths."""
+    return bool(w.size) and bool(np.all((w == 0) | (w == 1)))
+
+
 def compress_unit_weights(w: np.ndarray, wdt) -> np.ndarray:
-    """Return ``w`` as uint8 when every entry is exactly 0 or 1 (unit-weight
-    graphs: real edges weigh 1, padding 0), else as ``wdt``.
+    """Return ``w`` as uint8 when :func:`is_unit_weights`, else as ``wdt``.
 
     uint8 bucket weights cost 4x less host->device upload and 4x less HBM
     read per iteration; the step casts back to the weight dtype on use
     (fused by XLA), and 0/1 cast exactly, so results are bit-identical."""
-    if w.size and np.all((w == 0) | (w == 1)):
+    if is_unit_weights(w):
         return w.astype(np.uint8)
     return w.astype(wdt)
 
@@ -747,9 +753,11 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
     if use_sparse:
         src_s, ckey_s, w_s, ay_s, ts_s = seg.sort_edges_by_vertex_comm(
             hs, ckey_h, hw, jnp.take(env.cdeg_ext, hd),
-            jnp.take(env.csize_ext, hd))
+            jnp.take(env.csize_ext, hd),
+            src_bound=nv_local + 1, key_bound=nv_total)
     else:
-        src_s, ckey_s, w_s = seg.sort_edges_by_vertex_comm(hs, ckey_h, hw)
+        src_s, ckey_s, w_s = seg.sort_edges_by_vertex_comm(
+            hs, ckey_h, hw, src_bound=nv_local + 1, key_bound=nv_total)
     starts = seg.run_starts(src_s, ckey_s)
     eiy, _ = seg.run_totals(w_s, starts)
     i_s = jnp.minimum(src_s, nv_local - 1)

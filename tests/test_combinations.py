@@ -88,3 +88,23 @@ def test_threshold_cycling_multishard(rgg384):
     r = louvain_phases(rgg384, nshards=8, threshold_cycling=True)
     r1 = louvain_phases(rgg384, threshold_cycling=True)
     assert np.array_equal(r.communities, r1.communities)
+
+
+@pytest.mark.parametrize("et_mode", [1, 2])
+def test_coloring_with_early_termination(rgg384, et_mode):
+    """Coloring x ET — the reference's distLouvainMethodWithColoring ET
+    variants (/root/reference/louvain.cpp:951-1431): frozen vertices must
+    stay frozen inside the per-class commits, and quality must hold."""
+    r = louvain_phases(rgg384, coloring=6, et_mode=et_mode)
+    r0 = louvain_phases(rgg384)
+    assert modularity(rgg384, r.communities) >= \
+        0.8 * modularity(rgg384, r0.communities)
+
+
+def test_vertex_ordering_with_early_termination(rgg384):
+    """Ordering x ET — the reference's VertexOrder ET variants
+    (/root/reference/louvain.cpp:1627-2102)."""
+    r = louvain_phases(rgg384, vertex_ordering=6, et_mode=1)
+    r0 = louvain_phases(rgg384)
+    assert modularity(rgg384, r.communities) >= \
+        0.8 * modularity(rgg384, r0.communities)
