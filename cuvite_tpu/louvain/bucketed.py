@@ -175,7 +175,11 @@ class BucketPlan:
 
         heavy_v = np.nonzero(deg > widths[-1])[0]
         if len(heavy_v):
-            hmask = np.isin(s, heavy_v)
+            # Boolean-table lookup instead of np.isin: O(ne) vs isin's
+            # sort-based O(ne log ne) (~0.1 s/phase at scale 18).
+            is_heavy = np.zeros(nv_local + 1, dtype=bool)
+            is_heavy[heavy_v] = True
+            hmask = is_heavy[s]
             hs, hd, hw = s[hmask], d[hmask], ww[hmask]
             n = len(hs)
             npad = max(int(2 ** np.ceil(np.log2(max(n, 1)))), 8)

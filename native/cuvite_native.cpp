@@ -86,6 +86,57 @@ int64_t cv_build_csr(int64_t nv, int64_t ne, const int64_t* src,
     xw.assign(w, w + ne);
   }
 
+  // Small-nv fast path: counting-sort by src (stable), then per-row dense
+  // accumulation with a generation-stamped scratch — 3 linear passes
+  // instead of the radix sort's 2*ceil(log2 nv)/8 scatter passes
+  // (~4x faster for coarsened community graphs, whose nv shrinks while ne
+  // stays large).  Bit-identical to the sort path: within a row, weights
+  // of duplicate (src, dst) pairs accumulate in input order (exactly the
+  // grouping a stable sort produces), and each row's unique tails are
+  // emitted sorted ascending.
+  if ((uint64_t)nv <= (1ull << 22)) {
+    std::vector<int64_t> row_start(nv + 1, 0);
+    for (int64_t j = 0; j < m; ++j) row_start[xs[j] + 1]++;
+    for (int64_t v = 0; v < nv; ++v) row_start[v + 1] += row_start[v];
+    std::vector<int64_t> rd(m);
+    std::vector<double> rw(m);
+    {
+      std::vector<int64_t> pos(row_start.begin(), row_start.end() - 1);
+      for (int64_t j = 0; j < m; ++j) {
+        const int64_t p = pos[xs[j]]++;
+        rd[p] = xd[j];
+        rw[p] = xw[j];
+      }
+    }
+    std::vector<double> acc(nv, 0.0);
+    std::vector<int64_t> seen(nv, -1);
+    std::vector<int64_t> uniq;
+    std::memset(offsets_out, 0, (nv + 1) * sizeof(int64_t));
+    int64_t n_out = 0;
+    for (int64_t r = 0; r < nv; ++r) {
+      uniq.clear();
+      for (int64_t k = row_start[r]; k < row_start[r + 1]; ++k) {
+        const int64_t d = rd[k];
+        if (seen[d] != r) {
+          seen[d] = r;
+          acc[d] = rw[k];
+          uniq.push_back(d);
+        } else {
+          acc[d] += rw[k];
+        }
+      }
+      std::sort(uniq.begin(), uniq.end());
+      offsets_out[r + 1] = (int64_t)uniq.size();
+      for (int64_t d : uniq) {
+        tails_out[n_out] = d;
+        weights_out[n_out] = acc[d];
+        ++n_out;
+      }
+    }
+    for (int64_t v = 0; v < nv; ++v) offsets_out[v + 1] += offsets_out[v];
+    return n_out;
+  }
+
   // LSD radix sort of the composite key src*nv + dst with the weight as
   // payload.  Stable, so duplicate edges stay in input order and the f64
   // coalescing sums accumulate in exactly the order the numpy path's

@@ -93,18 +93,41 @@ def test_threshold_cycling_multishard(rgg384):
 @pytest.mark.parametrize("et_mode", [1, 2])
 def test_coloring_with_early_termination(rgg384, et_mode):
     """Coloring x ET — the reference's distLouvainMethodWithColoring ET
-    variants (/root/reference/louvain.cpp:951-1431): frozen vertices must
-    stay frozen inside the per-class commits, and quality must hold."""
-    r = louvain_phases(rgg384, coloring=6, et_mode=et_mode)
+    variants (/root/reference/louvain.cpp:951-1431): the freeze mask must
+    actually bite inside the per-class commits (the run must differ from
+    coloring without ET — falsifiable if the mask is dropped), and quality
+    must hold."""
+    kw = dict(et_delta=0.9) if et_mode == 2 else {}
+    r = louvain_phases(rgg384, coloring=6, et_mode=et_mode, **kw)
+    rc = louvain_phases(rgg384, coloring=6)
     r0 = louvain_phases(rgg384)
     assert modularity(rgg384, r.communities) >= \
         0.8 * modularity(rgg384, r0.communities)
+    if et_mode == 1:
+        # Falsifiable mask check (mode 1 only): dropping the frozen mask
+        # inside the class commits reverts the run to plain coloring.  The
+        # mask plumbing is shared by all modes; mode 2's freeze criterion
+        # ("stable for 2 iterations") happens to freeze only vertices that
+        # would not have moved again on this graph, so its run can
+        # legitimately equal the no-ET run.
+        traj = [(p.iterations, p.num_vertices) for p in r.phases]
+        traj_c = [(p.iterations, p.num_vertices) for p in rc.phases]
+        assert (traj != traj_c
+                or not np.array_equal(r.communities, rc.communities)), \
+            "ET changed nothing under coloring (freeze mask dropped?)"
 
 
 def test_vertex_ordering_with_early_termination(rgg384):
     """Ordering x ET — the reference's VertexOrder ET variants
-    (/root/reference/louvain.cpp:1627-2102)."""
-    r = louvain_phases(rgg384, vertex_ordering=6, et_mode=1)
+    (/root/reference/louvain.cpp:1627-2102); same falsifiability bar as
+    the coloring x ET test."""
+    r = louvain_phases(rgg384, vertex_ordering=6, et_mode=2, et_delta=0.9)
+    ro = louvain_phases(rgg384, vertex_ordering=6)
     r0 = louvain_phases(rgg384)
     assert modularity(rgg384, r.communities) >= \
         0.8 * modularity(rgg384, r0.communities)
+    traj = [(p.iterations, p.num_vertices) for p in r.phases]
+    traj_o = [(p.iterations, p.num_vertices) for p in ro.phases]
+    assert (traj != traj_o
+            or not np.array_equal(r.communities, ro.communities)), \
+        "ET changed nothing under vertex ordering (freeze mask dropped?)"
