@@ -144,6 +144,13 @@ class BucketPlan:
         is_self = d == (s + base)
         np.add.at(self_loop, s[is_self], ww[is_self])
 
+        # Unit-weight graphs (R-MAT, unweighted inputs): every real edge
+        # weighs exactly 1, so the per-bucket weight matrix IS the has-edge
+        # mask — skip the [nb, width] f64 weight gather entirely and emit
+        # uint8 (the dtype the device upload wants anyway, see
+        # compress_unit_weights).
+        unit = bool(len(ww) == 0 or np.all(ww == 1.0))
+
         buckets = []
         prev = 0
         for width in widths:
@@ -161,7 +168,6 @@ class BucketPlan:
             verts = np.full(nb_pad, nv_local, dtype=np.int64)
             verts[:nb] = sel
             dmat = np.zeros((nb_pad, width), dtype=dst.dtype)
-            wmat = np.zeros((nb_pad, width), dtype=w.dtype)
             # One vectorized gather per bucket; column padding uses the
             # vertex's own global id with weight 0 (a zero-weight self-edge
             # never becomes a candidate and adds 0 to counter0).
@@ -170,7 +176,12 @@ class BucketPlan:
             has = cols[None, :] < deg[sel][:, None]
             idx = np.minimum(idx, max(len(d) - 1, 0))
             dmat[:nb] = np.where(has, d[idx], (sel + base)[:, None])
-            wmat[:nb] = np.where(has, ww[idx], 0.0)
+            if unit:
+                wmat = np.zeros((nb_pad, width), dtype=np.uint8)
+                wmat[:nb] = has
+            else:
+                wmat = np.zeros((nb_pad, width), dtype=w.dtype)
+                wmat[:nb] = np.where(has, ww[idx], 0.0)
             buckets.append(Bucket(width=width, verts=verts, dst=dmat, w=wmat))
 
         heavy_v = np.nonzero(deg > widths[-1])[0]

@@ -117,17 +117,20 @@ def test_coloring_with_early_termination(rgg384, et_mode):
             "ET changed nothing under coloring (freeze mask dropped?)"
 
 
-def test_vertex_ordering_with_early_termination(rgg384):
+@pytest.mark.parametrize("et_mode", [1, 2])
+def test_vertex_ordering_with_early_termination(rgg384, et_mode):
     """Ordering x ET — the reference's VertexOrder ET variants
     (/root/reference/louvain.cpp:1627-2102); same falsifiability bar as
-    the coloring x ET test."""
-    r = louvain_phases(rgg384, vertex_ordering=6, et_mode=2, et_delta=0.9)
+    the coloring x ET test (mode 1 = the freeze-mask mode)."""
+    kw = dict(et_delta=0.9) if et_mode == 2 else {}
+    r = louvain_phases(rgg384, vertex_ordering=6, et_mode=et_mode, **kw)
     ro = louvain_phases(rgg384, vertex_ordering=6)
     r0 = louvain_phases(rgg384)
     assert modularity(rgg384, r.communities) >= \
         0.8 * modularity(rgg384, r0.communities)
-    traj = [(p.iterations, p.num_vertices) for p in r.phases]
-    traj_o = [(p.iterations, p.num_vertices) for p in ro.phases]
-    assert (traj != traj_o
-            or not np.array_equal(r.communities, ro.communities)), \
-        "ET changed nothing under vertex ordering (freeze mask dropped?)"
+    if et_mode == 1:
+        traj = [(p.iterations, p.num_vertices) for p in r.phases]
+        traj_o = [(p.iterations, p.num_vertices) for p in ro.phases]
+        assert (traj != traj_o
+                or not np.array_equal(r.communities, ro.communities)), \
+            "ET changed nothing under vertex ordering (freeze mask dropped?)"

@@ -49,6 +49,30 @@ def test_build_csr_matches_numpy(symmetrize, seed):
     assert np.array_equal(w_n.astype(g.weights.dtype), g.weights)
 
 
+@pytest.mark.parametrize("symmetrize", [True, False])
+def test_build_csr_radix_branch_matches_numpy(symmetrize):
+    """nv > 2^22 forces the LSD-radix branch (the small-nv dense-accumulator
+    fast path covers every other CSR test): its bit-identical-to-numpy
+    contract for production-scale graphs must stay pinned.  Edges are
+    concentrated on high vertex ids so the sparse offsets array stays
+    cheap."""
+    from cuvite_tpu.core.graph import Graph
+
+    nv = (1 << 22) + 11
+    ne = 4096
+    rng = np.random.default_rng(3)
+    src = rng.integers(nv - 300, nv, size=ne)
+    dst = rng.integers(nv - 300, nv, size=ne)
+    src[: ne // 4] = src[ne // 2: ne // 2 + ne // 4]   # duplicates
+    dst[: ne // 4] = dst[ne // 2: ne // 2 + ne // 4]
+    w = rng.random(ne)
+    off_n, tails_n, w_n = native.build_csr(nv, src, dst, w, symmetrize)
+    g = Graph.from_edges(nv, src, dst, weights=w, symmetrize=symmetrize)
+    assert np.array_equal(off_n, g.offsets)
+    assert np.array_equal(tails_n, g.tails)
+    assert np.array_equal(w_n.astype(g.weights.dtype), g.weights)
+
+
 def test_build_csr_rejects_out_of_range():
     with pytest.raises(ValueError):
         native.build_csr(4, np.array([0, 5]), np.array([1, 2]),
