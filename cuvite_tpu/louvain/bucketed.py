@@ -148,8 +148,12 @@ class BucketPlan:
         # weighs exactly 1, so the per-bucket weight matrix IS the has-edge
         # mask — skip the [nb, width] f64 weight gather entirely and emit
         # uint8 (the dtype the device upload wants anyway, see
-        # compress_unit_weights).
-        unit = bool(len(ww) == 0 or np.all(ww == 1.0))
+        # compress_unit_weights).  Deliberately NARROWER than
+        # is_unit_weights: that predicate admits {0, 1} mixtures (safe for
+        # dtype compression of an already-built matrix), but the mask
+        # substitution here requires every real edge to weigh exactly 1 —
+        # a real 0-weight edge would be promoted to 1 by the mask.
+        unit = len(ww) == 0 or bool(np.all(ww == 1.0))
 
         buckets = []
         prev = 0
@@ -341,9 +345,11 @@ def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
 
 
 def is_unit_weights(w: np.ndarray) -> bool:
-    """True when every entry is exactly 0 or 1 (unit-weight graphs: real
-    edges weigh 1, padding 0) — the single source of the uint8-compression
-    eligibility rule, shared by the single-shard and stacked paths."""
+    """True when every entry is exactly 0 or 1 — the uint8 DTYPE-compression
+    eligibility rule for already-built weight matrices (single-shard and
+    stacked upload paths).  Distinct from BucketPlan.build's stricter
+    mask-substitution predicate (all real weights exactly 1), which must
+    reject {0, 1} mixtures."""
     return bool(w.size) and bool(np.all((w == 0) | (w == 1)))
 
 
