@@ -62,6 +62,12 @@ def build_parser() -> argparse.ArgumentParser:
     src.add_argument("--write-graph", "-s", metavar="FILE",
                      help="write the generated graph in Vite binary format")
 
+    src.add_argument("--platform", choices=["cpu", "tpu", "axon"],
+                     default=None,
+                     help="pin the jax backend (e.g. cpu on a TPU-attached "
+                          "host whose device tunnel is unavailable; plugin "
+                          "registration otherwise overrides JAX_PLATFORMS)")
+
     dist = p.add_argument_group("distributed (multi-host)")
     dist.add_argument("--distributed", action="store_true",
                       help="connect this process to a multi-host run via "
@@ -169,6 +175,16 @@ def validate(args) -> None:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     validate(args)
+
+    if args.platform:
+        # Before any jax backend touch.  A JAX_PLATFORMS env var is NOT
+        # enough here: an out-of-tree PJRT plugin registered from
+        # sitecustomize (e.g. the axon TPU tunnel) overrides it, and a
+        # wedged tunnel hangs backend init indefinitely — this flag is the
+        # reliable way to pin the cpu backend on a TPU-attached host.
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
 
     if args.distributed:
         # Before any jax backend touch: after this, jax.devices() is the

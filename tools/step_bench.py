@@ -1,14 +1,16 @@
 """Single-step microbenchmark on the current default backend.
 
-Times one bucketed sweep on a phase-0 R-MAT slab with an honest readback
-(block_until_ready does not reliably block over the axon tunnel — a
-scalar fetch does), and reports the tunnel round-trip latency separately
-so device time can be read off the difference.
+Times one bucketed sweep on a phase-0 R-MAT slab through the SAME
+PhaseRunner the driver uses (no duplicated upload recipe), with an honest
+readback (block_until_ready does not reliably block over the axon tunnel —
+a scalar fetch does), and reports the dispatch round-trip latency
+separately so device time can be read off the difference.
 
 Usage:
     python tools/step_bench.py            # scale 18, default backend
     AB_SCALE=20 python tools/step_bench.py
     CUVITE_QUAD_MAX=256 python tools/step_bench.py   # dedup-cutover A/B
+    CUVITE_PLATFORM=cpu python tools/step_bench.py   # pin cpu backend
 
 NEVER run this under a tight external timeout on the TPU: a client killed
 mid-compile can wedge the axon tunnel for hours.
@@ -38,13 +40,8 @@ import numpy as np
 
 from cuvite_tpu.core.distgraph import DistGraph
 from cuvite_tpu.io.generate import generate_rmat
-from cuvite_tpu.louvain import driver as drv
-from cuvite_tpu.louvain.bucketed import (
-    QUADRATIC_MAX_WIDTH,
-    BucketPlan,
-    build_assemble_perm,
-    compress_unit_weights,
-)
+from cuvite_tpu.louvain.bucketed import QUADRATIC_MAX_WIDTH
+from cuvite_tpu.louvain.driver import PhaseRunner
 
 
 def main():
@@ -52,38 +49,18 @@ def main():
     print(f"# backend={jax.default_backend()} scale={scale} "
           f"QUAD_MAX={QUADRATIC_MAX_WIDTH}", flush=True)
     g = generate_rmat(scale, edge_factor=16, seed=1)
-    dg = DistGraph.build(g, 1)
-    sh = dg.shards[0]
-    plan = BucketPlan.build(np.asarray(sh.src), np.asarray(sh.dst),
-                            np.asarray(sh.w), dg.nv_pad, 0)
-    nvt = dg.total_padded_vertices
-    vdt, wdt = np.int32, np.float32
-    sentinel = int(np.iinfo(vdt).max)
-    vdeg = jnp.asarray(dg.padded_weighted_degrees(), dtype=wdt)
-    comm = jnp.arange(nvt, dtype=vdt)
-    constant = jnp.asarray(1.0 / g.total_edge_weight_twice(), dtype=wdt)
     t0 = time.perf_counter()
-    buckets = tuple(
-        (jnp.asarray(b.verts.astype(vdt)), jnp.asarray(b.dst.astype(vdt)),
-         jnp.asarray(compress_unit_weights(b.w, wdt)))
-        for b in plan.buckets)
-    heavy = (jnp.asarray(plan.heavy_src.astype(vdt)),
-             jnp.asarray(plan.heavy_dst.astype(vdt)),
-             jnp.asarray(plan.heavy_w.astype(wdt)))
-    self_loop = jnp.asarray(plan.self_loop.astype(wdt))
-    perm = jnp.asarray(build_assemble_perm(
-        [b.verts for b in plan.buckets], nvt))
-    jax.block_until_ready(buckets[-1])
-    print(f"# upload {time.perf_counter() - t0:.2f}s "
-          f"({sum(b.dst.size for b in plan.buckets)/1e6:.1f}M slots)",
-          flush=True)
+    dg = DistGraph.build(g, 1)
+    runner = PhaseRunner(dg, engine="bucketed")
+    # Force upload completion with a real readback (not block_until_ready).
+    _ = np.asarray(runner.comm0[0:1])
+    print(f"# plan+upload {time.perf_counter() - t0:.2f}s", flush=True)
+
+    comm = runner.comm0
 
     def step(c):
-        return drv._bucketed_jit(
-            buckets, heavy, self_loop, c, vdeg, constant, perm,
-            nv_total=nvt, sentinel=sentinel, accum_dtype="float32",
-            pallas_flags=tuple([False] * len(buckets)),
-            pallas_interpret=jax.default_backend() != "tpu")
+        return runner._step(None, None, None, c, runner.vdeg,
+                            runner.constant)
 
     t0 = time.perf_counter()
     out = step(comm)
@@ -91,13 +68,16 @@ def main():
     print(f"# first call (compile) {time.perf_counter() - t0:.1f}s",
           flush=True)
 
-    # Tunnel/dispatch round-trip latency baseline.
+    # Dispatch round-trip latency baseline: warm the exact timed
+    # expression first, then take min-of-5 like the step timing.
     x = jnp.zeros(())
-    _ = float(x)
-    t0 = time.perf_counter()
+    _ = float(jnp.add(x, 1.0))
+    rtts = []
     for _ in range(5):
+        t0 = time.perf_counter()
         _ = float(jnp.add(x, 1.0))
-    rtt = (time.perf_counter() - t0) / 5
+        rtts.append(time.perf_counter() - t0)
+    rtt = min(rtts)
     print(f"# scalar round-trip {rtt*1e3:.1f} ms", flush=True)
 
     c = comm
