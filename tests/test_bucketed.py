@@ -230,3 +230,22 @@ def test_zero_weight_edges_engines_agree():
             zip(sort_trace, bucket_trace)):
         np.testing.assert_array_equal(t1, t2, err_msg=f"iter {it}")
         assert m1 == m2
+
+
+def test_build_assemble_perm_properties():
+    """Direct pin of the scatter-free assembly map: bucket vertices map to
+    their own row in the concatenated space, everyone else (heavy /
+    degree-0 / padding) to the trailing default slot."""
+    from cuvite_tpu.louvain.bucketed import build_assemble_perm
+
+    nv = 10
+    verts_a = np.array([3, 7, nv, nv])     # padded bucket: rows 0..3
+    verts_b = np.array([1, 2, 5])          # second bucket: rows 4..6
+    perm = build_assemble_perm([verts_a, verts_b], nv)
+    total = len(verts_a) + len(verts_b)
+    assert perm.dtype == np.int32 and perm.shape == (nv,)
+    assert perm[3] == 0 and perm[7] == 1          # bucket a rows
+    assert perm[1] == 4 and perm[2] == 5 and perm[5] == 6
+    # not in any bucket -> default slot
+    for v in (0, 4, 6, 8, 9):
+        assert perm[v] == total, (v, perm[v])
