@@ -1,0 +1,25 @@
+"""Shared bootstrap for the tools/ scripts.
+
+Importing this module (FIRST, before anything touches a jax backend):
+- puts the repo root on sys.path;
+- pins the backend from $CUVITE_PLATFORM if set — this must happen before
+  any device call, because a sitecustomize-registered PJRT plugin (the
+  axon TPU tunnel) wins over a JAX_PLATFORMS env var, and a wedged tunnel
+  hangs backend init indefinitely;
+- points jax at the repo's persistent compile cache.
+"""
+
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+import jax  # noqa: E402
+
+if os.environ.get("CUVITE_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["CUVITE_PLATFORM"])
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(REPO_ROOT, ".jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

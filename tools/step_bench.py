@@ -20,20 +20,10 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: F401  (backend pin + compile cache, must be first)
 
 import jax
-
-# CUVITE_PLATFORM=cpu forces the cpu backend BEFORE any device call (the
-# axon plugin wins over a JAX_PLATFORMS env var, and its init hangs
-# indefinitely while the tunnel is wedged).
-if os.environ.get("CUVITE_PLATFORM"):
-    jax.config.update("jax_platforms", os.environ["CUVITE_PLATFORM"])
-
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(os.path.dirname(
-                      os.path.abspath(__file__))), ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import jax.numpy as jnp
 import numpy as np

@@ -1,0 +1,78 @@
+"""Capture an xprof trace of the bucketed step and print top ops.
+
+Runs 3 chained steps through PhaseRunner under jax.profiler.trace, then
+parses the xplane with xprof (framework_op_stats) and prints the top
+device ops by self time.  Note: the XLA:CPU backend does not emit per-op
+device rows — this tool is for the TPU.
+
+Usage:  python tools/trace_step.py      (AB_SCALE to change the graph)
+NEVER run under a tight external timeout on the TPU (wedge hazard).
+"""
+
+import glob
+import json
+import os
+import shutil
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: F401  (backend pin + compile cache, must be first)
+
+import jax
+
+# Fail fast on a missing profiler dependency BEFORE any device work.
+from xprof.convert import raw_to_tool_data as rtd
+
+from cuvite_tpu.core.distgraph import DistGraph
+from cuvite_tpu.io.generate import generate_rmat
+from cuvite_tpu.louvain.driver import PhaseRunner
+
+
+def main():
+    scale = int(os.environ.get("AB_SCALE", "18"))
+    g = generate_rmat(scale, edge_factor=16, seed=1)
+    runner = PhaseRunner(DistGraph.build(g, 1), engine="bucketed")
+
+    def step(c):
+        return runner._step(None, None, None, c, runner.vdeg,
+                            runner.constant)
+
+    out = step(runner.comm0)
+    _ = float(out[1])   # warm (compile)
+
+    trace_dir = os.environ.get("TRACE_DIR", "/tmp/cuvite_trace")
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    t0 = time.perf_counter()
+    with jax.profiler.trace(trace_dir):
+        c = runner.comm0
+        for _ in range(3):
+            tgt, mod, _, _ = step(c)
+            c = tgt
+        _ = float(mod)
+    print(f"# traced 3 steps in {time.perf_counter()-t0:.2f}s -> {trace_dir}",
+          flush=True)
+
+    pbs = glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True)
+    data, _ctype = rtd.xspace_to_tool_data(pbs, "framework_op_stats",
+                                           {"tqx": "out:csv"})
+    if isinstance(data, bytes):
+        data = data.decode()
+    tbl = json.loads(data)
+    tbl = tbl[0] if isinstance(tbl, list) else tbl
+    cols = [cc["label"] for cc in tbl["cols"]]
+    ix = {label: i for i, label in enumerate(cols)}
+    rows = [[cc.get("v") for cc in r["c"]] for r in tbl["rows"]]
+    dev = [r for r in rows if r[ix["Host/device"]] == "Device"]
+    key = "Total self-time (us)"
+    dev.sort(key=lambda r: -(r[ix[key]] or 0))
+    total = sum(r[ix[key]] or 0 for r in dev)
+    print(f"# device self time over 3 steps: {total/1e6:.3f}s")
+    for r in dev[:20]:
+        print(f"{(r[ix[key]] or 0)/1e3:9.1f} ms  "
+              f"{str(r[ix['Operation Type']])[:24]:24} "
+              f"{str(r[ix['Operation Name']])[:70]}")
+
+
+if __name__ == "__main__":
+    main()
