@@ -62,11 +62,12 @@ def build_parser() -> argparse.ArgumentParser:
     src.add_argument("--write-graph", "-s", metavar="FILE",
                      help="write the generated graph in Vite binary format")
 
-    src.add_argument("--platform", choices=["cpu", "tpu", "axon"],
-                     default=None,
-                     help="pin the jax backend (e.g. cpu on a TPU-attached "
-                          "host whose device tunnel is unavailable; plugin "
-                          "registration otherwise overrides JAX_PLATFORMS)")
+    rt = p.add_argument_group("runtime")
+    rt.add_argument("--platform", choices=["cpu", "tpu", "axon"],
+                    default=None,
+                    help="pin the jax backend (e.g. cpu on a TPU-attached "
+                         "host whose device tunnel is unavailable; plugin "
+                         "registration otherwise overrides JAX_PLATFORMS)")
 
     dist = p.add_argument_group("distributed (multi-host)")
     dist.add_argument("--distributed", action="store_true",

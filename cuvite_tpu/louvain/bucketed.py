@@ -52,9 +52,19 @@ DEFAULT_BUCKETS = (8, 16, 32, 64, 128, 256, 384, 512, 768, 1024, 1536,
 def _env_int(name: str, default: int) -> int:
     import os
 
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
     try:
-        return int(os.environ.get(name, default))
+        return int(raw)
     except ValueError:
+        # A malformed knob (typo'd digit, stray unicode) must not silently
+        # measure the baseline while the operator believes it changed.
+        import warnings
+
+        warnings.warn(
+            f"{name}={raw!r} is not an integer; using default {default}",
+            stacklevel=2)
         return default
 
 

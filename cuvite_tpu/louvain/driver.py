@@ -1012,6 +1012,14 @@ def louvain_phases(
             "running the 'bucketed' engine for this configuration instead",
             stacklevel=2)
         engine = "bucketed"
+    if engine == "sort" and exchange == "sparse" and nshards > 1:
+        # The check sits here, not in PhaseRunner, so it fires only on the
+        # USER'S explicit exchange='sparse' — not on an 'auto' resolution
+        # (same misattribution standard as the pallas/fused fallbacks).
+        warnings.warn(
+            "exchange='sparse' is implemented on the bucketed engine only; "
+            "the sort engine runs the replicated exchange (O(nv_total) "
+            "per-chip state)", stacklevel=2)
 
     nv0 = graph.num_vertices
     comm_all = np.arange(nv0, dtype=np.int64)
@@ -1115,8 +1123,32 @@ def louvain_phases(
             phase_exchange = exchange
         color_dev = None
         n_classes = 0
-        if (coloring or vertex_ordering) and phase == 0:
+        # Class-restricted plans (one sweep per iteration) exist on the
+        # single-shard bucketed engine only; other configurations degrade
+        # and must say so (cf. the pallas/fused fallbacks).
+        multi_mesh = nshards > 1 or (
+            mesh is not None and int(np.prod(mesh.devices.shape)) > 1)
+        ordering_fallback = bool(
+            vertex_ordering and not coloring
+            and (multi_mesh or engine == "sort"))
+        if ordering_fallback and phase == 0:
+            # Plain schedule: skip the coloring entirely — computing colors
+            # nobody consumes would waste an O(E) multi-hash pass on the
+            # largest graph of the run.
+            warnings.warn(
+                "vertex_ordering is implemented on the single-shard "
+                "bucketed engine; this configuration falls back to the "
+                "PLAIN schedule", stacklevel=2)
+        if (coloring or vertex_ordering) and phase == 0 \
+                and not ordering_fallback:
             from cuvite_tpu.louvain.coloring import multi_hash_coloring
+
+            if coloring and (multi_mesh or engine == "sort"):
+                warnings.warn(
+                    "class-restricted color sweeps are single-shard "
+                    "bucketed only; this configuration runs the legacy "
+                    "schedule costing n_classes full sweeps per iteration",
+                    stacklevel=2)
 
             n_hash = max((coloring or vertex_ordering) // 2, 1)
             colors, n_colors = multi_hash_coloring(

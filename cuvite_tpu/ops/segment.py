@@ -74,8 +74,28 @@ def sort_edges_by_vertex_comm(src, ckey, w, *extras, src_bound=None,
     comparator (measured 4-5x faster for the row sorts on TPU).  Equal
     packed keys are exactly equal (src, ckey) pairs and the sort is stable
     either way, so results are bit-identical to the lexicographic path.
+
+    INVARIANT: every src must be < src_bound and every ckey < key_bound,
+    or packing corrupts the order (an overflowing ckey bleeds into src's
+    bits; at kbits+sbits == 31 the int32 sign bit flips and the row sorts
+    to the FRONT).  Callers pass src_bound = nv_local + 1 (padding rows
+    carry src == nv_local) and key_bound = nv_total (community ids live in
+    padded vertex space).  Set CUVITE_DEBUG_BOUNDS=1 to verify at runtime
+    (host callback per sort — test/debug builds only).
     """
     if src_bound is not None and key_bound is not None:
+        import os
+
+        if os.environ.get("CUVITE_DEBUG_BOUNDS", "0").lower() \
+                not in ("", "0", "false"):
+            def _check(smax, kmax):
+                if int(smax) >= int(src_bound) or int(kmax) >= int(key_bound):
+                    raise AssertionError(
+                        f"packed-sort bound violation: max src {int(smax)} "
+                        f"(bound {src_bound}), max ckey {int(kmax)} "
+                        f"(bound {key_bound})")
+
+            jax.debug.callback(_check, jnp.max(src), jnp.max(ckey))
         kbits = max(int(key_bound) - 1, 1).bit_length()
         sbits = max(int(src_bound) - 1, 1).bit_length()
         # int64 packing needs jax_enable_x64 (int64 silently degrades to

@@ -134,3 +134,24 @@ def test_first_step_two_cliques(two_cliques):
     assert len(set(comm[:5])) == 1
     assert len(set(comm[5:])) == 1
     assert comm[0] != comm[5]
+
+
+def test_packed_sort_debug_bounds_guard(monkeypatch):
+    """CUVITE_DEBUG_BOUNDS=1 turns packed-key bound violations into hard
+    errors instead of silent key corruption (advisor r2 finding)."""
+    import jax.numpy as jnp
+
+    from cuvite_tpu.ops.segment import sort_edges_by_vertex_comm
+
+    src = jnp.array([0, 1, 2], dtype=jnp.int32)
+    ckey = jnp.array([0, 1, 9], dtype=jnp.int32)  # >= key_bound
+    w = jnp.ones(3, dtype=jnp.float32)
+    monkeypatch.setenv("CUVITE_DEBUG_BOUNDS", "1")
+    with pytest.raises(AssertionError, match="bound violation"):
+        sort_edges_by_vertex_comm(src, ckey, w, src_bound=4, key_bound=4)
+    # In-bounds input passes and round-trips exactly.
+    out = sort_edges_by_vertex_comm(
+        src, jnp.array([2, 1, 0], dtype=jnp.int32), w,
+        src_bound=4, key_bound=4)
+    assert [int(x) for x in out[0]] == [0, 1, 2]
+    assert [int(x) for x in out[1]] == [2, 1, 0]

@@ -143,3 +143,36 @@ def test_et_freeze_reduces_or_keeps_work():
 def test_coloring_multishard_still_works(karate):
     res = louvain_phases(karate, nshards=4, coloring=8)
     assert mod_oracle(karate, res.communities) >= 0.38
+
+
+def test_coloring_multishard_warns(karate):
+    """Degradations must be loud (VERDICT r2 weak #8): multi-shard coloring
+    runs the legacy n_classes-full-sweeps schedule and says so."""
+    with pytest.warns(UserWarning, match="full sweeps"):
+        louvain_phases(karate, nshards=4, coloring=8)
+
+
+def test_vertex_ordering_multishard_warns_plain_fallback(karate):
+    with pytest.warns(UserWarning, match="PLAIN schedule"):
+        louvain_phases(karate, nshards=4, vertex_ordering=8)
+
+
+def test_vertex_ordering_sort_engine_warns_plain_fallback(karate):
+    with pytest.warns(UserWarning, match="PLAIN schedule"):
+        louvain_phases(karate, engine="sort", vertex_ordering=8)
+
+
+def test_sparse_exchange_sort_engine_warns(karate):
+    """exchange='sparse' on the sort engine must not be silently ignored."""
+    with pytest.warns(UserWarning, match="sort engine"):
+        louvain_phases(karate, nshards=4, engine="sort", exchange="sparse")
+
+
+def test_env_int_malformed_warns(monkeypatch):
+    from cuvite_tpu.louvain.bucketed import _env_int
+
+    monkeypatch.setenv("CUVITE_TEST_KNOB", "25x6")
+    with pytest.warns(UserWarning, match="CUVITE_TEST_KNOB"):
+        assert _env_int("CUVITE_TEST_KNOB", 7) == 7
+    monkeypatch.setenv("CUVITE_TEST_KNOB", "256")
+    assert _env_int("CUVITE_TEST_KNOB", 7) == 256
