@@ -26,18 +26,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BASELINE_EDGES_PER_SEC_PER_CHIP = 1.0e9 / 64.0
 
-# Persistent XLA compilation cache: compiles dominate first-run wall time
-# (~30s per distinct phase shape on v5e); repeated bench runs skip them
-# entirely.  Opt out with CUVITE_NO_COMPILE_CACHE=1.
-if not os.environ.get("CUVITE_NO_COMPILE_CACHE"):
-    import jax
+# Persistent XLA compilation cache (opt out with CUVITE_NO_COMPILE_CACHE=1).
+from cuvite_tpu.utils.compile_cache import enable_compile_cache
 
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                     ".jax_cache"),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+enable_compile_cache()
 
 
 def _init_backend(max_tries: int = 2, timeout_s: int = 75) -> str:

@@ -136,6 +136,9 @@ class BucketPlan:
         """`src` holds local indices (pad = nv_local); `dst` global padded
         ids; `base` is this shard's first global id (for self-loop
         detection)."""
+        plan = _build_native(src, dst, w, nv_local, base, widths)
+        if plan is not None:
+            return plan
         real = src < nv_local
         s = src[real].astype(np.int64)
         d = dst[real].astype(np.int64)
@@ -229,6 +232,83 @@ class BucketPlan:
             self_loop=self_loop.astype(w.dtype),
             has_heavy=has_heavy,
         )
+
+
+def _build_native(src, dst, w, nv_local, base, widths):
+    """Native-streamed BucketPlan (cv_plan_scan + cv_bucket_fill): two O(E)
+    C++ passes with no transient larger than O(nv), vs the numpy path's
+    multi-gigabyte int64 copies and per-class gather matrices at benchmark
+    scales (VERDICT r2 item 3).  Returns None — caller falls back to numpy
+    — when the library is unavailable, the slab is small, dtypes are mixed,
+    or the slab is not CSR-sorted with tail padding (e.g. the color-class
+    masked plans).  Output is bit-identical to the numpy path (pinned by
+    tests/test_native.py)."""
+    from cuvite_tpu import native as cvn
+
+    if (not cvn.available() or len(src) < cvn.MIN_NATIVE_EDGES
+            or src.dtype != dst.dtype
+            or src.dtype not in (np.int32, np.int64)
+            or w.dtype not in (np.float32, np.float64)
+            or not (src.flags.c_contiguous and dst.flags.c_contiguous
+                    and w.flags.c_contiguous)):
+        return None
+    self_loop64, sorted_, unit, tail_ok = cvn.plan_scan(
+        src, dst, w, nv_local, base)
+    if not (sorted_ and tail_ok):
+        return None
+    deg = np.bincount(src, minlength=nv_local + 1)[:nv_local]
+    widths_arr = np.asarray(widths, dtype=np.int64)
+    nw = len(widths_arr)
+    cls_idx = np.searchsorted(widths_arr, deg, side="left")
+    heavy_mask = deg > widths_arr[-1]
+    in_bucket = (deg > 0) & ~heavy_mask
+    full_counts = np.bincount(cls_idx[in_bucket], minlength=nw)
+    kept = np.nonzero(full_counts)[0]
+    remap = np.full(nw + 1, 255, dtype=np.uint8)
+    remap[kept] = np.arange(len(kept), dtype=np.uint8)
+    cls = np.full(nv_local, 255, dtype=np.uint8)
+    cls[in_bucket] = remap[cls_idx[in_bucket]]
+    cls[heavy_mask] = 254
+    row_start = np.zeros(nv_local, dtype=np.int64)
+    np.cumsum(deg[:-1], out=row_start[1:])
+
+    nb = full_counts[kept]
+    nb_pad = np.array(
+        [1 << int(n - 1).bit_length() if n > 1 else 1 for n in nb],
+        dtype=np.int64)
+    widths_kept = widths_arr[kept]
+    wm_dtype = np.uint8 if unit else w.dtype
+    verts_list, dmat_list, wmat_list = [], [], []
+    for np_, width in zip(nb_pad, widths_kept):
+        verts_list.append(np.full(np_, nv_local, dtype=np.int64))
+        dmat_list.append(np.zeros((np_, width), dtype=dst.dtype))
+        wmat_list.append(np.zeros((np_, width), dtype=wm_dtype))
+    n_h = int(deg[heavy_mask].sum())
+    if n_h:
+        heavy_pad = max(int(2 ** np.ceil(np.log2(max(n_h, 1)))), 8)
+    else:
+        heavy_pad = 8
+    heavy_src = np.full(heavy_pad, nv_local, dtype=src.dtype)
+    heavy_dst = np.zeros(heavy_pad, dtype=dst.dtype)
+    heavy_w = np.zeros(heavy_pad, dtype=w.dtype)
+    cvn.bucket_fill(dst, w, nv_local, base, row_start,
+                    deg.astype(np.int64), cls, widths_kept, nb_pad,
+                    verts_list, dmat_list, wmat_list, unit, heavy_pad,
+                    heavy_src, heavy_dst, heavy_w)
+    buckets = [
+        Bucket(width=int(width), verts=v, dst=d, w=ww)
+        for width, v, d, ww in zip(widths_kept, verts_list, dmat_list,
+                                   wmat_list)
+    ]
+    return BucketPlan(
+        nv_local=nv_local,
+        buckets=buckets,
+        heavy_src=heavy_src,
+        heavy_dst=heavy_dst,
+        heavy_w=heavy_w,
+        self_loop=self_loop64.astype(w.dtype),
+        has_heavy=n_h > 0,
+    )
 
 
 @dataclasses.dataclass
@@ -831,7 +911,7 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
                                        axis_name, accum_dtype)
     else:
         modularity = seg.modularity_terms(counter0, comm_deg, constant, gsum,
-                                          accum_dtype)
+                                          accum_dtype, axis_name=axis_name)
     n_moved = gsum(jnp.sum(move.astype(jnp.int32)))
     return target, modularity, n_moved, overflow
 

@@ -131,7 +131,7 @@ def louvain_step_local(
 
     # --- modularity of the INPUT assignment (louvain.cpp:2433-2481) -------
     modularity = seg.modularity_terms(counter0, comm_deg, constant, gsum,
-                                      accum_dtype)
+                                      accum_dtype, axis_name=axis_name)
 
     n_moved = gsum(jnp.sum(move.astype(jnp.int32)))
     return StepOut(target=target, modularity=modularity, n_moved=n_moved)

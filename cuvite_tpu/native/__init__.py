@@ -74,6 +74,19 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.cv_balanced_parts.argtypes = [i64, p_i64, i64, p_i64]
     lib.cv_openmp_threads.restype = ctypes.c_int
     lib.cv_openmp_threads.argtypes = []
+    vp = ctypes.c_void_p
+    p_u8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    lib.cv_plan_scan.restype = ctypes.c_int
+    lib.cv_plan_scan.argtypes = [i64, i64, i64, vp, vp, vp, ctypes.c_int,
+                                 ctypes.c_int, p_f64,
+                                 ctypes.POINTER(ctypes.c_int)]
+    lib.cv_bucket_fill.restype = ctypes.c_int
+    lib.cv_bucket_fill.argtypes = [i64, i64, vp, vp, ctypes.c_int,
+                                   ctypes.c_int, p_i64, p_i64, p_u8,
+                                   ctypes.c_int, p_i64, p_i64,
+                                   ctypes.POINTER(vp), ctypes.POINTER(vp),
+                                   ctypes.POINTER(vp), ctypes.c_int, i64,
+                                   vp, vp, vp]
 
 
 def _load():
@@ -97,7 +110,11 @@ def _load():
         lib = ctypes.CDLL(so)
         _bind(lib)
         _LIB = lib
-    except OSError:
+    except (OSError, AttributeError):
+        # AttributeError: a library built from older sources (but with a
+        # newer mtime, e.g. preserved-time copies) lacking newly added
+        # symbols — fall back to numpy rather than crash ("accelerator,
+        # never a requirement").
         _LIB = False
         return None
     return _LIB
@@ -186,3 +203,47 @@ def balanced_parts(offsets: np.ndarray, nparts: int) -> np.ndarray:
     parts = np.empty(nparts + 1, dtype=np.int64)
     lib.cv_balanced_parts(len(offsets) - 1, offsets, nparts, parts)
     return parts
+
+
+def _vp(a: np.ndarray):
+    return ctypes.c_void_p(a.ctypes.data)
+
+
+def plan_scan(src, dst, w, nv: int, base: int):
+    """One fused pass over an edge slab: (self_loop[f64 nv], sorted, unit,
+    tail_padding_ok).  src/dst must share an int32/int64 dtype; w is
+    float32/float64 (see cv_plan_scan)."""
+    lib = _load()
+    assert lib is not None
+    self_loop = np.zeros(nv, dtype=np.float64)
+    flags = ctypes.c_int(0)
+    rc = lib.cv_plan_scan(
+        len(src), nv, base, _vp(src), _vp(dst), _vp(w),
+        int(src.dtype == np.int64), int(w.dtype == np.float64),
+        self_loop, ctypes.byref(flags))
+    if rc != 0:
+        raise ValueError(f"cv_plan_scan failed (rc={rc})")
+    f = flags.value
+    return self_loop, bool(f & 1), bool(f & 2), bool(f & 4)
+
+
+def bucket_fill(dst, w, nv: int, base: int, row_start, deg, cls,
+                widths_kept, nb_pad, verts_list, dmat_list, wmat_list,
+                unit: bool, heavy_pad: int, hsrc, hdst, hw) -> None:
+    """Stream the CSR-ordered slab into pre-allocated bucket matrices and
+    heavy triples (see cv_bucket_fill; caller pre-fills all padding)."""
+    lib = _load()
+    assert lib is not None
+    n = len(widths_kept)
+    mk = lambda arrs: (ctypes.c_void_p * max(n, 1))(  # noqa: E731
+        *[a.ctypes.data for a in arrs], *([0] * (max(n, 1) - len(arrs))))
+    rc = lib.cv_bucket_fill(
+        nv, base, _vp(dst), _vp(w),
+        int(dst.dtype == np.int64), int(w.dtype == np.float64),
+        row_start, deg, cls, n,
+        np.ascontiguousarray(widths_kept, dtype=np.int64),
+        np.ascontiguousarray(nb_pad, dtype=np.int64),
+        mk(verts_list), mk(dmat_list), mk(wmat_list),
+        int(unit), heavy_pad, _vp(hsrc), _vp(hdst), _vp(hw))
+    if rc != 0:
+        raise ValueError(f"cv_bucket_fill failed (rc={rc})")

@@ -120,6 +120,16 @@ def ds_tree_sum(hi, lo=None):
     return hi[0], lo[0]
 
 
+def ds_psum(pair, axis_name):
+    """Exact cross-shard reduction of a scalar ds pair: all_gather the S
+    per-shard pairs (S scalars — negligible traffic) and ds-tree-sum them.
+    A plain psum of hi/lo parts would re-lose up to S*eps relative — the
+    very error the ds formulation removes."""
+    hi = jax.lax.all_gather(pair[0], axis_name)
+    lo = jax.lax.all_gather(pair[1], axis_name)
+    return ds_tree_sum(hi, lo)
+
+
 def ds_segment_sums_sorted(keys, vals, vals_lo=None):
     """Per-run ds sums of ``vals`` (optionally already a ds pair with
     ``vals_lo``) grouped by SORTED ``keys``.

@@ -47,10 +47,40 @@ def spmd_env(comm_local, axis_name):
     return comm_full, lambda x: jax.lax.psum(x, axis_name)
 
 
-def modularity_terms(counter0, comm_deg, constant, gsum, accum_dtype):
+# Sentinel accum_dtype selecting double-single (f32-pair) accumulation for
+# the in-loop modularity sums — the scale-safe mode for graphs whose 2m
+# makes plain f32 reductions eat the 1e-6 convergence threshold (see
+# cuvite_tpu/ops/exactsum.py and driver.DS_MIN_TOTAL_WEIGHT).
+DS_ACCUM = "ds32"
+
+
+def modularity_terms(counter0, comm_deg, constant, gsum, accum_dtype,
+                     axis_name=None):
     """Q = e·c − a²·c² from the per-vertex current-community weights and the
     (already globally reduced) community degrees
-    (cf. distComputeModularity, /root/reference/louvain.cpp:2433-2481)."""
+    (cf. distComputeModularity, /root/reference/louvain.cpp:2433-2481).
+
+    ``accum_dtype=DS_ACCUM`` accumulates both big reductions in
+    double-single f32 pairs (error O(log n * 2^-48) instead of the plain
+    tree sum's O(log n * 2^-24)) and collapses to one f32 at the end —
+    the in-loop analog of the reference's C++ double accumulation
+    (louvain.cpp:2433-2481).  ``axis_name`` is required in SPMD ds mode
+    (the cross-shard pair reduction must stay exact; ``gsum`` alone would
+    re-lose the low words)."""
+    if accum_dtype == DS_ACCUM:
+        from cuvite_tpu.ops import exactsum as ds
+
+        le = ds.ds_tree_sum(counter0)
+        if axis_name is not None:
+            le = ds.ds_psum(le, axis_name)
+        # comm_deg is globally replicated after gsum: no cross-shard reduce;
+        # square each entry exactly (two_prod) before the pair tree-sum.
+        p, e = ds.two_prod(comm_deg, comm_deg)
+        la2 = ds.ds_tree_sum(p, e)
+        c = ds.ds_from_f32(constant)
+        q = ds.ds_add(ds.ds_mul(le, c),
+                      ds.ds_neg(ds.ds_mul(la2, ds.ds_mul(c, c))))
+        return q[0] + q[1]
     acc = counter0.dtype if accum_dtype is None else accum_dtype
     le_xx = gsum(jnp.sum(counter0.astype(acc)))
     # comm_deg is globally replicated after gsum: no second psum.

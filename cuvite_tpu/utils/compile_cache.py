@@ -1,0 +1,30 @@
+"""Shared persistent-XLA-compile-cache setup.
+
+Every entry point that benefits from cached executables (bench.py, the
+driver artifacts in __graft_entry__.py, the tools/ scripts) enables the
+SAME repo-local cache through this one helper, so the cache directory,
+the min-compile-time knob, and the CUVITE_NO_COMPILE_CACHE opt-out cannot
+drift apart.  Compiles dominate first-run wall time (~30s per distinct
+phase shape on v5e); cached reruns skip them entirely — which also means
+a short TPU-tunnel-alive window is enough for a full bench run.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache(root: str | None = None) -> None:
+    """Point jax at ``<root>/.jax_cache`` (default: the repo root) unless
+    CUVITE_NO_COMPILE_CACHE is set.  Call before the first compilation;
+    safe to call more than once."""
+    if os.environ.get("CUVITE_NO_COMPILE_CACHE"):
+        return
+    import jax
+
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(root, ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -414,12 +414,167 @@ void cv_balanced_parts(int64_t nv, const int64_t* offsets, int64_t nparts,
   parts_out[nparts] = nv;
 }
 
-int cv_openmp_threads(void) {
+// ---------------------------------------------------------------------------
+// Bucket-plan construction (the host side of the degree-bucketed TPU engine,
+// cuvite_tpu/louvain/bucketed.py BucketPlan.build).
+//
+// The numpy builder materializes O(E) int64/float64 transients per phase
+// (real-mask copies, per-class [nb, width] index/gather matrices) — tens of
+// GB at benchmark scales.  These two entry points stream the slab once each
+// and write ONLY the output matrices, with no transient larger than O(nv):
+//
+//   cv_plan_scan  — one fused pass: per-vertex self-loop accumulation (f64,
+//                   slab order, bit-identical to np.add.at), the unit-weight
+//                   predicate, the src-sortedness check, and the
+//                   padding-only-at-tail check that gates cv_bucket_fill.
+//   cv_bucket_fill — one pass over CSR rows writing each vertex's padded
+//                   bucket row (dst matrix + weight-or-mask matrix) and the
+//                   heavy-vertex edge triples, exactly as the numpy path
+//                   lays them out (pad columns carry the vertex's own
+//                   global id with weight 0).
+//
+// The cheap O(nv) planning arithmetic (degree bincount, width-class
+// assignment, row counters, pow2 padding) stays in numpy — it never touches
+// O(E) memory.  Role analog: the reference's device bucketing + clmap setup
+// (/root/reference/louvain_cuda.cu:1426-1592), which likewise builds its
+// degree-class layout outside the iteration hot path.
+
+}  // extern "C" — template helpers need C++ linkage
+
+template <typename I, typename W>
+static int plan_scan_impl(int64_t ne, int64_t nv, int64_t base, const I* src,
+                          const I* dst, const W* w, double* self_loop,
+                          int* flags_out) {
+  int sorted = 1, unit = 1, tail_ok = 1;
+  int64_t prev = -1;
+  int seen_pad = 0;
+  for (int64_t j = 0; j < ne; ++j) {
+    const int64_t s = (int64_t)src[j];
+    if (s >= nv) {
+      seen_pad = 1;
+      continue;
+    }
+    if (s < 0) {  // malformed slab: force the caller's numpy fallback
+      *flags_out = 0;
+      return 0;
+    }
+    if (seen_pad) tail_ok = 0;
+    if (s < prev) sorted = 0;
+    if (!sorted || !tail_ok) {
+      // The caller is guaranteed to decline the plan; don't stream the
+      // rest of an O(E) slab computing discarded self-loops (color-class
+      // masked plans hit this every phase).
+      *flags_out = 0;
+      return 0;
+    }
+    prev = s;
+    const double wj = (double)w[j];
+    if (wj != 1.0) unit = 0;
+    if ((int64_t)dst[j] == s + base) self_loop[s] += wj;
+  }
+  *flags_out = sorted | (unit << 1) | (tail_ok << 2);
+  return 0;
+}
+
+extern "C" int cv_plan_scan(int64_t ne, int64_t nv, int64_t base,
+                            const void* src, const void* dst, const void* w,
+                            int id64, int w64, double* self_loop,
+                            int* flags_out) {
+  if (id64) {
+    if (w64)
+      return plan_scan_impl(ne, nv, base, (const int64_t*)src,
+                            (const int64_t*)dst, (const double*)w, self_loop,
+                            flags_out);
+    return plan_scan_impl(ne, nv, base, (const int64_t*)src,
+                          (const int64_t*)dst, (const float*)w, self_loop,
+                          flags_out);
+  }
+  if (w64)
+    return plan_scan_impl(ne, nv, base, (const int32_t*)src,
+                          (const int32_t*)dst, (const double*)w, self_loop,
+                          flags_out);
+  return plan_scan_impl(ne, nv, base, (const int32_t*)src,
+                        (const int32_t*)dst, (const float*)w, self_loop,
+                        flags_out);
+}
+
+// cls codes: kept-class index, 254 = heavy, 255 = no bucket (degree 0).
+// Caller pre-fills verts with nv (padding), zero-fills dmat/wmat, and
+// pre-pads the heavy arrays; this routine writes only real entries.
+// Requires the slab CSR-sorted with padding at the tail (cv_plan_scan
+// flags); returns -1 on a counter overrun (corrupt cls/deg inputs).
+template <typename I, typename W, typename WM>
+static int bucket_fill_impl(int64_t nv, int64_t base, const I* dst,
+                            const W* w, const int64_t* row_start,
+                            const int64_t* deg, const uint8_t* cls,
+                            int nclasses, const int64_t* widths,
+                            const int64_t* nb_pad, int64_t** verts_ptrs,
+                            I** dmat_ptrs, WM** wmat_ptrs, int unit,
+                            int64_t heavy_pad, I* hsrc, I* hdst, W* hw) {
+  std::vector<int64_t> counter(nclasses, 0);
+  int64_t hk = 0;
+  for (int64_t v = 0; v < nv; ++v) {
+    const uint8_t c = cls[v];
+    if (c == 255) continue;
+    const int64_t rs = row_start[v];
+    const int64_t d = deg[v];
+    if (c == 254) {
+      if (hk + d > heavy_pad) return -1;
+      for (int64_t k = 0; k < d; ++k) {
+        hsrc[hk] = (I)v;
+        hdst[hk] = dst[rs + k];
+        hw[hk] = w[rs + k];
+        ++hk;
+      }
+      continue;
+    }
+    if (c >= nclasses) return -1;
+    const int64_t width = widths[c];
+    const int64_t row = counter[c]++;
+    if (row >= nb_pad[c]) return -1;
+    verts_ptrs[c][row] = v;
+    I* drow = dmat_ptrs[c] + row * width;
+    WM* wrow = wmat_ptrs[c] + row * width;
+    for (int64_t k = 0; k < d; ++k) {
+      drow[k] = dst[rs + k];
+      wrow[k] = unit ? (WM)1 : (WM)w[rs + k];
+    }
+    const I self_id = (I)(v + base);
+    for (int64_t k = d; k < width; ++k) drow[k] = self_id;
+  }
+  return 0;
+}
+
+extern "C" int cv_bucket_fill(
+    int64_t nv, int64_t base, const void* dst, const void* w, int id64,
+    int w64, const int64_t* row_start, const int64_t* deg,
+    const uint8_t* cls, int nclasses, const int64_t* widths,
+    const int64_t* nb_pad, void** verts_ptrs, void** dmat_ptrs,
+    void** wmat_ptrs, int unit, int64_t heavy_pad, void* hsrc, void* hdst,
+    void* hw) {
+  // unit=1 writes uint8 {0,1} masks; otherwise wmat shares w's dtype.
+#define CV_FILL(I_, W_, WM_)                                                  \
+  bucket_fill_impl<I_, W_, WM_>(                                              \
+      nv, base, (const I_*)dst, (const W_*)w, row_start, deg, cls, nclasses, \
+      widths, nb_pad, (int64_t**)verts_ptrs, (I_**)dmat_ptrs,                \
+      (WM_**)wmat_ptrs, unit, heavy_pad, (I_*)hsrc, (I_*)hdst, (W_*)hw)
+  if (id64) {
+    if (w64) return unit ? CV_FILL(int64_t, double, uint8_t)
+                         : CV_FILL(int64_t, double, double);
+    return unit ? CV_FILL(int64_t, float, uint8_t)
+                : CV_FILL(int64_t, float, float);
+  }
+  if (w64) return unit ? CV_FILL(int32_t, double, uint8_t)
+                       : CV_FILL(int32_t, double, double);
+  return unit ? CV_FILL(int32_t, float, uint8_t)
+              : CV_FILL(int32_t, float, float);
+#undef CV_FILL
+}
+
+extern "C" int cv_openmp_threads(void) {
 #if defined(_OPENMP)
   return omp_get_max_threads();
 #else
   return 1;
 #endif
 }
-
-}  // extern "C"

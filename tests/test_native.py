@@ -210,3 +210,120 @@ def test_coarsen_native_matches_numpy():
     assert np.array_equal(cg_native.offsets, cg_numpy.offsets)
     assert np.array_equal(cg_native.tails, cg_numpy.tails)
     assert np.array_equal(cg_native.weights, cg_numpy.weights)
+
+
+# ---------------------------------------------------------------------------
+# Native bucket-plan builder (cv_plan_scan + cv_bucket_fill): bit-identical
+# to the numpy BucketPlan.build, including the heavy class, weighted
+# graphs, and the uint8 unit-weight compression.
+
+def _numpy_plan(src, dst, w, nv_local, base):
+    from cuvite_tpu.louvain.bucketed import BucketPlan
+
+    old = native._LIB
+    native._LIB = False  # force the numpy path
+    try:
+        return BucketPlan.build(src, dst, w, nv_local=nv_local, base=base)
+    finally:
+        native._LIB = old
+
+
+def _assert_plans_equal(pn, pp):
+    assert len(pn.buckets) == len(pp.buckets)
+    for a, b in zip(pn.buckets, pp.buckets):
+        assert a.width == b.width
+        assert np.array_equal(a.verts, b.verts)
+        assert np.array_equal(a.dst, b.dst)
+        assert a.w.dtype == b.w.dtype
+        assert np.array_equal(a.w, b.w)
+    for f in ("heavy_src", "heavy_dst", "heavy_w", "self_loop"):
+        assert np.array_equal(getattr(pn, f), getattr(pp, f)), f
+    assert pn.has_heavy == pp.has_heavy
+
+
+def _slab(g, nsh=1, s=0):
+    from cuvite_tpu.core.distgraph import DistGraph
+
+    dg = DistGraph.build(g, nsh)
+    sh = dg.shards[s]
+    return (np.asarray(sh.src), np.asarray(sh.dst), np.asarray(sh.w),
+            dg.nv_pad, s * dg.nv_pad)
+
+
+def test_bucket_plan_native_matches_numpy_rmat():
+    from cuvite_tpu.io.generate import generate_rmat
+    from cuvite_tpu.louvain.bucketed import _build_native
+
+    src, dst, w, nvp, base = _slab(generate_rmat(14, edge_factor=16, seed=1))
+    pn = _build_native(src, dst, w, nvp, base,
+                       widths=__import__("cuvite_tpu.louvain.bucketed",
+                                         fromlist=["DEFAULT_BUCKETS"]
+                                         ).DEFAULT_BUCKETS)
+    assert pn is not None
+    # (R-MAT coalesces duplicate edges to weight 2, so the plan is NOT
+    # unit-weight — the uint8 path is pinned by the ring test below.)
+    _assert_plans_equal(pn, _numpy_plan(src, dst, w, nvp, base))
+
+
+def test_bucket_plan_native_unit_uint8():
+    """A duplicate-free unit-weight graph compresses weights to uint8 on
+    both paths."""
+    from cuvite_tpu.core.graph import Graph
+    from cuvite_tpu.louvain.bucketed import DEFAULT_BUCKETS, _build_native
+
+    n = 1 << 17
+    s = np.arange(n, dtype=np.int64)
+    g = Graph.from_edges(n, s, (s + 1) % n)
+    src, dst, w, nvp, base = _slab(g)
+    pn = _build_native(src, dst, w, nvp, base, widths=DEFAULT_BUCKETS)
+    assert pn is not None
+    assert all(b.w.dtype == np.uint8 for b in pn.buckets)
+    _assert_plans_equal(pn, _numpy_plan(src, dst, w, nvp, base))
+
+
+def test_bucket_plan_native_matches_numpy_weighted():
+    from cuvite_tpu.io.generate import generate_rgg
+    from cuvite_tpu.louvain.bucketed import DEFAULT_BUCKETS, _build_native
+
+    src, dst, w, nvp, base = _slab(generate_rgg(1 << 15, seed=3))
+    pn = _build_native(src, dst, w, nvp, base, widths=DEFAULT_BUCKETS)
+    assert pn is not None
+    _assert_plans_equal(pn, _numpy_plan(src, dst, w, nvp, base))
+    assert all(b.w.dtype == w.dtype for b in pn.buckets)
+
+
+def test_bucket_plan_native_heavy_class():
+    """Hub graph: the degree-10240 vertex goes down the heavy path with
+    edges in exactly the numpy order."""
+    from cuvite_tpu.core.graph import Graph
+    from cuvite_tpu.louvain.bucketed import DEFAULT_BUCKETS, _build_native
+
+    edges = []
+    nv = 40 * 256 + 1
+    hub = nv - 1
+    for c in range(40):
+        b0 = c * 256
+        for i in range(256):
+            edges.append((b0 + i, b0 + (i + 1) % 256))
+            edges.append((b0 + i, b0 + (i + 7) % 256))
+    for v in range(hub):  # hub degree 10240 > DEFAULT_BUCKETS[-1]
+        edges.append((hub, v))
+    e = np.array(edges, dtype=np.int64)
+    g = Graph.from_edges(nv, e[:, 0], e[:, 1])
+    src, dst, w, nvp, base = _slab(g)
+    pn = _build_native(src, dst, w, nvp, base, widths=DEFAULT_BUCKETS)
+    assert pn is not None and pn.has_heavy
+    _assert_plans_equal(pn, _numpy_plan(src, dst, w, nvp, base))
+
+
+def test_bucket_plan_native_declines_masked_slab():
+    """Color-class plans mask src mid-slab (padding not at the tail): the
+    native path must decline and the numpy fallback handle it."""
+    from cuvite_tpu.io.generate import generate_rmat
+    from cuvite_tpu.louvain.bucketed import DEFAULT_BUCKETS, _build_native
+
+    src, dst, w, nvp, base = _slab(generate_rmat(13, edge_factor=16, seed=2))
+    src = src.copy()
+    src[::3] = nvp  # mask every third edge to padding, mid-slab
+    assert _build_native(src, dst, w, nvp, base,
+                         widths=DEFAULT_BUCKETS) is None

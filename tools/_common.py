@@ -20,6 +20,6 @@ import jax  # noqa: E402
 if os.environ.get("CUVITE_PLATFORM"):
     jax.config.update("jax_platforms", os.environ["CUVITE_PLATFORM"])
 
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(REPO_ROOT, ".jax_cache"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from cuvite_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache(REPO_ROOT)
