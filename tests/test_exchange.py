@@ -149,3 +149,23 @@ def test_exchange_auto_cutover(monkeypatch):
         "auto above the cutover must not build ghost plans"
     assert np.array_equal(r_sparse.communities, r_repl.communities)
     assert r_sparse.modularity == pytest.approx(r_repl.modularity, abs=1e-6)
+
+
+def test_sparse_step_lowers_to_three_all_to_all():
+    """The packed exchange (VERDICT r2 item 5) must keep the per-iteration
+    collective count at 3 — owner-route, reply, ghost pull — not the
+    pre-packing 7.  Counted in the jax lowering, where each lax.all_to_all
+    appears exactly once (launch count is what ICI latency charges for;
+    a CPU-mesh wall clock cannot see it)."""
+    import re
+
+    import jax
+
+    g = generate_rmat(10, edge_factor=8, seed=1)
+    dg = DistGraph.build(g, 8)
+    runner = PhaseRunner(dg, mesh=make_mesh(8), engine="bucketed",
+                         exchange="sparse")
+    txt = jax.jit(runner._step).lower(
+        None, None, None, runner.comm0, runner.vdeg, runner.constant
+    ).as_text()
+    assert len(re.findall("all_to_all", txt)) == 3

@@ -145,16 +145,44 @@ def test_coloring_multishard_still_works(karate):
     assert mod_oracle(karate, res.communities) >= 0.38
 
 
-def test_coloring_multishard_warns(karate):
-    """Degradations must be loud (VERDICT r2 weak #8): multi-shard coloring
-    runs the legacy n_classes-full-sweeps schedule and says so."""
+def test_coloring_sort_engine_warns(karate):
+    """Degradations must be loud (VERDICT r2 weak #8): coloring on the sort
+    engine runs the legacy n_classes-full-sweeps schedule and says so.
+    (Multi-shard bucketed+replicated coloring is now class-restricted and
+    must NOT warn — see test_coloring_multishard_matches_single.)"""
     with pytest.warns(UserWarning, match="full sweeps"):
-        louvain_phases(karate, nshards=4, coloring=8)
+        louvain_phases(karate, nshards=4, engine="sort", coloring=8)
 
 
-def test_vertex_ordering_multishard_warns_plain_fallback(karate):
+def test_vertex_ordering_sparse_exchange_warns_plain_fallback(karate):
+    """Class plans are replicated-exchange only: an explicit sparse-exchange
+    ordering run degrades to the plain schedule, loudly."""
     with pytest.warns(UserWarning, match="PLAIN schedule"):
-        louvain_phases(karate, nshards=4, vertex_ordering=8)
+        louvain_phases(karate, nshards=4, vertex_ordering=8,
+                       exchange="sparse")
+
+
+def test_coloring_multishard_matches_single(karate):
+    """Distributed class-restricted coloring (VERDICT r2 item 8): the
+    8-shard schedule must reproduce the single-shard class-restricted
+    trajectory exactly (unit weights: every reduction is fp-exact)."""
+    import warnings as _w
+
+    r1 = louvain_phases(karate, coloring=8)
+    with _w.catch_warnings():
+        _w.simplefilter("error")  # supported config: no degradation warning
+        r8 = louvain_phases(karate, nshards=8, coloring=8,
+                            exchange="replicated")
+    assert np.array_equal(r8.communities, r1.communities)
+    assert r8.modularity == pytest.approx(r1.modularity, abs=1e-6)
+
+
+def test_ordering_multishard_matches_single():
+    g = generate_rmat(10, edge_factor=8, seed=4)
+    r1 = louvain_phases(g, vertex_ordering=8)
+    r4 = louvain_phases(g, nshards=4, vertex_ordering=8,
+                        exchange="replicated")
+    assert np.array_equal(r4.communities, r1.communities)
 
 
 def test_vertex_ordering_sort_engine_warns_plain_fallback(karate):

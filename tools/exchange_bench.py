@@ -1,0 +1,57 @@
+"""Sparse-vs-replicated exchange A/B on the virtual 8-device CPU mesh.
+
+Re-measures the gap after the round-3 collective packing (7 all_to_all
+per iteration -> 3, comm/exchange.py) — VERDICT r2 item 5.  The sparse
+plan is a MEMORY play (O(owned+ghosts) per-chip state vs O(nv_total)); a
+shrinking time gap is what makes the 2^26 auto-cutover
+(driver.AUTO_SPARSE_MIN_VERTICES) safe.
+
+Usage:
+    python tools/exchange_bench.py            # scales 18 20
+    AB_SCALES="18" python tools/exchange_bench.py
+"""
+
+import os
+import sys
+import time
+
+# Virtual 8-device mesh: must precede jax backend init (see conftest.py).
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("CUVITE_PLATFORM", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: F401,E402  (backend pin + compile cache)
+
+import jax  # noqa: E402
+
+from cuvite_tpu.io.generate import generate_rmat  # noqa: E402
+from cuvite_tpu.louvain.driver import louvain_phases  # noqa: E402
+
+
+def main():
+    scales = [int(s) for s in os.environ.get("AB_SCALES", "18 20").split()]
+    nsh = int(os.environ.get("AB_SHARDS", "8"))
+    print(f"# backend={jax.default_backend()} "
+          f"devices={len(jax.devices())} shards={nsh}", flush=True)
+    for scale in scales:
+        g = generate_rmat(scale, edge_factor=16, seed=1)
+        row = {}
+        for exchange in ("replicated", "sparse"):
+            # warm-up run eats compiles; timed run is steady-state
+            louvain_phases(g, nshards=nsh, exchange=exchange)
+            t0 = time.perf_counter()
+            res = louvain_phases(g, nshards=nsh, exchange=exchange)
+            wall = time.perf_counter() - t0
+            row[exchange] = (wall, res.modularity, res.total_iterations)
+            print(f"scale={scale} exchange={exchange:10s} wall={wall:8.1f}s "
+                  f"Q={res.modularity:.5f} iters={res.total_iterations}",
+                  flush=True)
+        r, s = row["replicated"][0], row["sparse"][0]
+        print(f"scale={scale} sparse/replicated = {s / r:.2f}x", flush=True)
+
+
+if __name__ == "__main__":
+    main()
