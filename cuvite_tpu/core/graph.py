@@ -84,6 +84,29 @@ class Graph:
         coalesced by summing weights.
         """
         policy = policy or default_policy()
+        from cuvite_tpu import native
+
+        # Unit-weight fast path (weights=None: R-MAT, unweighted inputs):
+        # the int32 native builder counts duplicates instead of summing f64
+        # ones — no 8-byte array exists anywhere, which is what makes
+        # single-host ingest of billion-edge unweighted graphs fit
+        # (tools/scale_model.md).  Output is bit-identical to the generic
+        # path after the policy cast (exact integer counts, rounded once)
+        # — which requires the policy weight dtype to BE f32: a wide
+        # (f64) policy must keep the generic f64 path or duplicate counts
+        # above 2^24 would round.
+        if (weights is None and len(src) >= native.MIN_NATIVE_EDGES
+                and native.available() and num_vertices <= 1 << 31
+                and policy.weight_dtype == np.float32):
+            offsets, tails, wcnt = native.build_csr_unit(
+                num_vertices, src, dst, symmetrize
+            )
+            return Graph(
+                offsets=offsets,
+                tails=tails.astype(policy.vertex_dtype, copy=False),
+                weights=wcnt.astype(policy.weight_dtype, copy=False),
+                policy=policy,
+            )
         src = np.asarray(src, dtype=np.int64)
         dst = np.asarray(dst, dtype=np.int64)
         # Accumulate duplicate-edge sums from the raw f64 weights; the cast
@@ -93,7 +116,6 @@ class Graph:
             w = np.ones(len(src), dtype=np.float64)
         else:
             w = np.asarray(weights, dtype=np.float64)
-        from cuvite_tpu import native
 
         # The native builder's composite radix key src*nv+dst only fits
         # uint64 for nv <= 2^32; beyond that use the numpy path.

@@ -221,4 +221,14 @@ def generate_rmat(
     else:
         src, dst = rmat_edges_numpy(scale, ne, seed, a, b, c)
     keep = src != dst
+    if scale < 31:
+        # Hand int32 ids to the unit-weight CSR path and free the int64
+        # generator output before ingest — at billion-edge scales the
+        # 8-byte copies are the difference between fitting one host or
+        # not (tools/scale_model.md).
+        s32 = src[keep].astype(np.int32)
+        del src
+        d32 = dst[keep].astype(np.int32)
+        del dst, keep
+        return Graph.from_edges(nv, s32, d32, policy=policy)
     return Graph.from_edges(nv, src[keep], dst[keep], policy=policy)

@@ -76,6 +76,11 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.cv_openmp_threads.argtypes = []
     vp = ctypes.c_void_p
     p_u8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    p_i32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    p_f32 = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    lib.cv_build_csr_unit.restype = i64
+    lib.cv_build_csr_unit.argtypes = [i64, i64, p_i32, p_i32, ctypes.c_int,
+                                      p_i64, p_i32, p_f32]
     lib.cv_plan_scan.restype = ctypes.c_int
     lib.cv_plan_scan.argtypes = [i64, i64, i64, vp, vp, vp, ctypes.c_int,
                                  ctypes.c_int, p_f64,
@@ -140,6 +145,27 @@ def build_csr(num_vertices: int, src: np.ndarray, dst: np.ndarray,
     wout = np.empty(cap, dtype=np.float64)
     n = lib.cv_build_csr(num_vertices, len(src), src, dst, w,
                          int(symmetrize), offsets, tails, wout)
+    if n < 0:
+        raise ValueError("edge endpoint out of range")
+    return offsets, tails[:n].copy(), wout[:n].copy()
+
+
+def build_csr_unit(num_vertices: int, src: np.ndarray, dst: np.ndarray,
+                   symmetrize: bool = True):
+    """Unit-weight edge list -> coalesced CSR with int32 ids and f32
+    duplicate counts as weights — no f64 array exists at any point
+    (identical output to build_csr with all-ones weights after the policy
+    cast; see cv_build_csr_unit).  Requires num_vertices <= 2^31."""
+    lib = _load()
+    assert lib is not None
+    src = np.ascontiguousarray(src, dtype=np.int32)
+    dst = np.ascontiguousarray(dst, dtype=np.int32)
+    cap = max(2 * len(src) if symmetrize else len(src), 1)
+    offsets = np.empty(num_vertices + 1, dtype=np.int64)
+    tails = np.empty(cap, dtype=np.int32)
+    wout = np.empty(cap, dtype=np.float32)
+    n = lib.cv_build_csr_unit(num_vertices, len(src), src, dst,
+                              int(symmetrize), offsets, tails, wout)
     if n < 0:
         raise ValueError("edge endpoint out of range")
     return offsets, tails[:n].copy(), wout[:n].copy()

@@ -26,6 +26,7 @@
 #include <cstdio>
 #include <cstring>
 #include <numeric>
+#include <type_traits>
 #include <sys/types.h>
 #include <vector>
 
@@ -33,10 +34,8 @@
 #include <omp.h>
 #endif
 
-extern "C" {
-
 // ---------------------------------------------------------------------------
-// CSR construction from an edge list.
+// CSR construction from an edge list (one template, two entry points).
 //
 // Matches cuvite_tpu.core.graph.Graph.from_edges exactly:
 //   - symmetrize: append (dst,src,w) for every non-self edge, after the
@@ -45,21 +44,43 @@ extern "C" {
 //   - coalesce duplicates by summing weights in double, in input order
 //     (numpy's np.add.at order after a stable argsort).
 //
-// offsets_out must hold nv+1 entries; tails_out/weights_out must hold
-// (symmetrize ? 2*ne : ne) entries.  Returns the number of unique CSR
-// entries written, or -1 on bad input (src/dst out of range).
-int64_t cv_build_csr(int64_t nv, int64_t ne, const int64_t* src,
-                     const int64_t* dst, const double* w, int symmetrize,
-                     int64_t* offsets_out, int64_t* tails_out,
-                     double* weights_out) {
-  // The composite radix key src*nv+dst must fit uint64.
-  if (nv < 0 || (uint64_t)nv > (1ull << 32)) return -1;
+// UNIT=true is the R-MAT / unweighted-input specialization: every edge
+// weighs exactly 1, so coalescing is duplicate COUNTING and ids ride
+// int32 end to end — no 8-byte array exists at any point, which is what
+// took single-host scale-26 ingest from an OOM at 131 GB to a measured
+// 56 GB peak (tools/scale_model.md).  weights_out[k] = (float)count is
+// bit-identical to the generic path's f64 sum-of-ones cast to f32 (both
+// round the exact integer once); callers therefore gate the unit path on
+// a float32 weight policy.
+//
+// Sort scheme: small-nv dense-accumulator fast path (counting-sort by
+// src + generation-stamped per-row scratch, ~4x for coarsened community
+// graphs), else byte-wise LSD radix on the composite key src*nv + dst.
+// Measured A/Bs on this host (60 M random edges, 1 core): 16-bit digits
+// are ~2x SLOWER (64 K per-bucket write streams thrash L1/TLB; 256 stay
+// cache-resident), and a 3-stream u32 dst-radix + counting-by-src
+// variant is ~1.6x slower (the nv-bucket scatter costs a cache miss per
+// element).  Allocation order keeps the radix peak at ~32 B/slot
+// (~16 B/slot for UNIT): the expanded edge list is freed/moved before
+// the ping-pong buffers are allocated.
+
+template <typename IdT, bool UNIT>
+static int64_t build_csr_impl(
+    int64_t nv, int64_t ne, const IdT* src, const IdT* dst, const double* w,
+    int symmetrize, int64_t* offsets_out, IdT* tails_out,
+    typename std::conditional<UNIT, float, double>::type* weights_out) {
+  using UId = typename std::make_unsigned<IdT>::type;
+  using WOut = typename std::conditional<UNIT, float, double>::type;
+  // The composite radix key src*nv+dst must fit uint64; UNIT ids int32.
+  const int64_t nv_cap =
+      UNIT ? ((int64_t)1 << 31) : ((int64_t)1 << 32);
+  if (nv < 0 || nv > nv_cap) return -1;
   for (int64_t j = 0; j < ne; ++j) {
     if (src[j] < 0 || src[j] >= nv || dst[j] < 0 || dst[j] >= nv) return -1;
   }
   // Expanded (virtually concatenated) edge list.
   int64_t m = ne;
-  std::vector<int64_t> xs, xd;
+  std::vector<UId> xs, xd;
   std::vector<double> xw;
   if (symmetrize) {
     int64_t nself = 0;
@@ -67,48 +88,54 @@ int64_t cv_build_csr(int64_t nv, int64_t ne, const int64_t* src,
     m = 2 * ne - nself;
     xs.resize(m);
     xd.resize(m);
-    xw.resize(m);
-    std::memcpy(xs.data(), src, ne * sizeof(int64_t));
-    std::memcpy(xd.data(), dst, ne * sizeof(int64_t));
-    std::memcpy(xw.data(), w, ne * sizeof(double));
+    if (!UNIT) xw.resize(m);
+    for (int64_t j = 0; j < ne; ++j) {
+      xs[j] = (UId)src[j];
+      xd[j] = (UId)dst[j];
+      if (!UNIT) xw[j] = w[j];
+    }
     int64_t k = ne;
     for (int64_t j = 0; j < ne; ++j) {
       if (src[j] != dst[j]) {
-        xs[k] = dst[j];
-        xd[k] = src[j];
-        xw[k] = w[j];
+        xs[k] = (UId)dst[j];
+        xd[k] = (UId)src[j];
+        if (!UNIT) xw[k] = w[j];
         ++k;
       }
     }
   } else {
-    xs.assign(src, src + ne);
-    xd.assign(dst, dst + ne);
-    xw.assign(w, w + ne);
+    xs.resize(m);
+    xd.resize(m);
+    if (!UNIT) xw.resize(m);
+    for (int64_t j = 0; j < ne; ++j) {
+      xs[j] = (UId)src[j];
+      xd[j] = (UId)dst[j];
+      if (!UNIT) xw[j] = w[j];
+    }
   }
 
   // Small-nv fast path: counting-sort by src (stable), then per-row dense
-  // accumulation with a generation-stamped scratch — 3 linear passes
-  // instead of the radix sort's 2*ceil(log2 nv)/8 scatter passes
-  // (~4x faster for coarsened community graphs, whose nv shrinks while ne
-  // stays large).  Bit-identical to the sort path: within a row, weights
-  // of duplicate (src, dst) pairs accumulate in input order (exactly the
-  // grouping a stable sort produces), and each row's unique tails are
-  // emitted sorted ascending.
+  // accumulation with a generation-stamped scratch.  Bit-identical to the
+  // sort path: within a row, duplicate (src, dst) pairs accumulate in
+  // input order (exactly the grouping a stable sort produces), and each
+  // row's unique tails are emitted sorted ascending.
   if ((uint64_t)nv <= (1ull << 22)) {
     std::vector<int64_t> row_start(nv + 1, 0);
-    for (int64_t j = 0; j < m; ++j) row_start[xs[j] + 1]++;
+    for (int64_t j = 0; j < m; ++j) row_start[(int64_t)xs[j] + 1]++;
     for (int64_t v = 0; v < nv; ++v) row_start[v + 1] += row_start[v];
-    std::vector<int64_t> rd(m);
-    std::vector<double> rw(m);
+    std::vector<UId> rd(m);
+    std::vector<double> rw;
+    if (!UNIT) rw.resize(m);
     {
       std::vector<int64_t> pos(row_start.begin(), row_start.end() - 1);
       for (int64_t j = 0; j < m; ++j) {
         const int64_t p = pos[xs[j]]++;
         rd[p] = xd[j];
-        rw[p] = xw[j];
+        if (!UNIT) rw[p] = xw[j];
       }
     }
-    std::vector<double> acc(nv, 0.0);
+    using Acc = typename std::conditional<UNIT, int64_t, double>::type;
+    std::vector<Acc> acc(nv, (Acc)0);
     std::vector<int64_t> seen(nv, -1);
     std::vector<int64_t> uniq;
     std::memset(offsets_out, 0, (nv + 1) * sizeof(int64_t));
@@ -116,20 +143,20 @@ int64_t cv_build_csr(int64_t nv, int64_t ne, const int64_t* src,
     for (int64_t r = 0; r < nv; ++r) {
       uniq.clear();
       for (int64_t k = row_start[r]; k < row_start[r + 1]; ++k) {
-        const int64_t d = rd[k];
+        const int64_t d = (int64_t)rd[k];
         if (seen[d] != r) {
           seen[d] = r;
-          acc[d] = rw[k];
+          if constexpr (UNIT) acc[d] = 1; else acc[d] = rw[k];
           uniq.push_back(d);
         } else {
-          acc[d] += rw[k];
+          if constexpr (UNIT) acc[d] += 1; else acc[d] += rw[k];
         }
       }
       std::sort(uniq.begin(), uniq.end());
       offsets_out[r + 1] = (int64_t)uniq.size();
       for (int64_t d : uniq) {
-        tails_out[n_out] = d;
-        weights_out[n_out] = acc[d];
+        tails_out[n_out] = (IdT)d;
+        weights_out[n_out] = (WOut)acc[d];
         ++n_out;
       }
     }
@@ -137,19 +164,8 @@ int64_t cv_build_csr(int64_t nv, int64_t ne, const int64_t* src,
     return n_out;
   }
 
-  // LSD radix sort of the composite key src*nv + dst with the weight as
-  // payload, 8-bit digits.  Measured A/Bs on this host (60 M random
-  // edges, 1 core): 16-bit digits are ~2x SLOWER (64 K per-bucket write
-  // streams thrash L1/TLB; 256 streams stay cache-resident), and a
-  // 3-stream u32 dst-radix + counting-by-src variant is ~1.6x slower
-  // (the nv-bucket scatter costs a cache miss per element) — byte-wise
-  // over the composite key is the right scheme for this machine.
-  // Stable, so duplicate edges stay in input order and the f64 coalescing
-  // sums accumulate in exactly the order the numpy path's np.add.at does
-  // (bit-identical results).  Only the bytes the key can actually occupy
-  // are sorted (2*ceil(log2 nv) bits).  Allocation order keeps the peak
-  // at ~32 B/slot (was ~56): xs/xd are freed and xw MOVED into pw before
-  // the second ping-pong buffers are allocated.
+  // Byte-wise LSD radix on the composite key (digit-width A/B rationale in
+  // the header comment).  Stable, so duplicate edges stay in input order.
   const uint64_t unv = (uint64_t)nv;
   std::vector<uint64_t> key(m);
   for (int64_t j = 0; j < m; ++j)
@@ -158,7 +174,8 @@ int64_t cv_build_csr(int64_t nv, int64_t ne, const int64_t* src,
   xd.clear(); xd.shrink_to_fit();
   std::vector<double> pw(std::move(xw));
   std::vector<uint64_t> key2(m);
-  std::vector<double> pw2(m);
+  std::vector<double> pw2;
+  if (!UNIT) pw2.resize(m);
   // Max key is nv*nv-1 < 2^(2*ceil(log2 nv)); computing the bound from
   // bits(nv-1) avoids evaluating unv*unv, which wraps at nv == 2^32.
   int key_bits = 0;
@@ -173,7 +190,7 @@ int64_t cv_build_csr(int64_t nv, int64_t ne, const int64_t* src,
 #else
     const int nt = 1;
 #endif
-    constexpr int DIGIT_BITS = 8;  // see A/B note above before changing
+    constexpr int DIGIT_BITS = 8;  // see the A/B note in the header
     constexpr int NB = 1 << DIGIT_BITS;
     constexpr uint64_t DMASK = NB - 1;
     std::vector<int64_t> hist((size_t)nt * NB);
@@ -207,31 +224,71 @@ int64_t cv_build_csr(int64_t nv, int64_t ne, const int64_t* src,
         for (int64_t j = lo; j < hi; ++j) {
           int64_t slot = h[(key[j] >> shift) & DMASK]++;
           key2[slot] = key[j];
-          pw2[slot] = pw[j];
+          if constexpr (!UNIT) pw2[slot] = pw[j];
         }
       }
       key.swap(key2);
-      pw.swap(pw2);
+      if constexpr (!UNIT) pw.swap(pw2);
     }
   }
 
-  // Linear coalesce of the sorted (key, weight) stream into the CSR.
+  // Linear coalesce of the sorted stream into the CSR.
   std::memset(offsets_out, 0, (nv + 1) * sizeof(int64_t));
   int64_t n_out = 0;
   uint64_t prev_key = ~0ull;
-  for (int64_t j = 0; j < m; ++j) {
-    if (key[j] == prev_key) {
-      weights_out[n_out - 1] += pw[j];
-    } else {
-      prev_key = key[j];
-      tails_out[n_out] = (int64_t)(key[j] % unv);
-      weights_out[n_out] = pw[j];
-      offsets_out[key[j] / unv + 1]++;
-      ++n_out;
+  if constexpr (UNIT) {
+    int64_t run_count = 0;
+    for (int64_t j = 0; j < m; ++j) {
+      if (key[j] == prev_key) {
+        ++run_count;
+      } else {
+        if (n_out) weights_out[n_out - 1] = (float)run_count;
+        prev_key = key[j];
+        run_count = 1;
+        tails_out[n_out] = (IdT)(key[j] % unv);
+        offsets_out[key[j] / unv + 1]++;
+        ++n_out;
+      }
+    }
+    if (n_out) weights_out[n_out - 1] = (float)run_count;
+  } else {
+    for (int64_t j = 0; j < m; ++j) {
+      if (key[j] == prev_key) {
+        weights_out[n_out - 1] += pw[j];
+      } else {
+        prev_key = key[j];
+        tails_out[n_out] = (IdT)(key[j] % unv);
+        weights_out[n_out] = pw[j];
+        offsets_out[key[j] / unv + 1]++;
+        ++n_out;
+      }
     }
   }
   for (int64_t v = 0; v < nv; ++v) offsets_out[v + 1] += offsets_out[v];
   return n_out;
+}
+
+extern "C" {
+
+// offsets_out must hold nv+1 entries; tails_out/weights_out must hold
+// (symmetrize ? 2*ne : ne) entries.  Returns the number of unique CSR
+// entries written, or -1 on bad input (src/dst out of range).
+int64_t cv_build_csr(int64_t nv, int64_t ne, const int64_t* src,
+                     const int64_t* dst, const double* w, int symmetrize,
+                     int64_t* offsets_out, int64_t* tails_out,
+                     double* weights_out) {
+  return build_csr_impl<int64_t, false>(nv, ne, src, dst, w, symmetrize,
+                                        offsets_out, tails_out, weights_out);
+}
+
+// Unit-weight int32 variant (see the template header).  Requires
+// nv <= 2^31; weights_out holds f32 duplicate counts.
+int64_t cv_build_csr_unit(int64_t nv, int64_t ne, const int32_t* src,
+                          const int32_t* dst, int symmetrize,
+                          int64_t* offsets_out, int32_t* tails_out,
+                          float* weights_out) {
+  return build_csr_impl<int32_t, true>(nv, ne, src, dst, nullptr, symmetrize,
+                                       offsets_out, tails_out, weights_out);
 }
 
 // ---------------------------------------------------------------------------

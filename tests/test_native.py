@@ -327,3 +327,63 @@ def test_bucket_plan_native_declines_masked_slab():
     src[::3] = nvp  # mask every third edge to padding, mid-slab
     assert _build_native(src, dst, w, nvp, base,
                          widths=DEFAULT_BUCKETS) is None
+
+
+@pytest.mark.parametrize("symmetrize", [True, False])
+def test_build_csr_unit_matches_generic(symmetrize):
+    """Unit-weight int32 builder (cv_build_csr_unit): identical CSR to the
+    generic path for weights=None, duplicates counted exactly."""
+    from cuvite_tpu.core.graph import Graph
+
+    nv, ne = 257, 4096
+    src, dst, _ = _random_edges(ne, nv, seed=5)
+    o, t, w = native.build_csr_unit(nv, src, dst, symmetrize=symmetrize)
+    old = native._LIB
+    native._LIB = False
+    try:
+        g = Graph.from_edges(nv, src, dst, symmetrize=symmetrize)
+    finally:
+        native._LIB = old
+    assert np.array_equal(o, g.offsets)
+    assert np.array_equal(t.astype(g.tails.dtype), g.tails)
+    assert np.array_equal(w.astype(g.weights.dtype), g.weights)
+
+
+def test_build_csr_unit_radix_branch():
+    nv = (1 << 22) + 11
+    ne = 4096
+    rng = np.random.default_rng(3)
+    src = rng.integers(nv - 300, nv, size=ne)
+    dst = rng.integers(nv - 300, nv, size=ne)
+    src[: ne // 4] = src[ne // 2: ne // 2 + ne // 4]
+    dst[: ne // 4] = dst[ne // 2: ne // 2 + ne // 4]
+    from cuvite_tpu.core.graph import Graph
+
+    o, t, w = native.build_csr_unit(nv, src, dst, symmetrize=True)
+    old = native._LIB
+    native._LIB = False
+    try:
+        g = Graph.from_edges(nv, src, dst, symmetrize=True)
+    finally:
+        native._LIB = old
+    assert np.array_equal(o, g.offsets)
+    assert np.array_equal(t.astype(g.tails.dtype), g.tails)
+    assert np.array_equal(w.astype(g.weights.dtype), g.weights)
+
+
+def test_from_edges_unit_dispatch():
+    """weights=None above the size threshold must take the int32 unit path
+    and produce the exact same Graph as the generic native path."""
+    from cuvite_tpu.core.graph import Graph
+
+    nv = 1 << 12
+    ne = native.MIN_NATIVE_EDGES + 17
+    rng = np.random.default_rng(9)
+    src = rng.integers(0, nv, ne)
+    dst = rng.integers(0, nv, ne)
+    g_unit = Graph.from_edges(nv, src, dst)                 # unit fast path
+    g_gen = Graph.from_edges(nv, src, dst,
+                             weights=np.ones(ne, dtype=np.float64))
+    assert np.array_equal(g_unit.offsets, g_gen.offsets)
+    assert np.array_equal(g_unit.tails, g_gen.tails)
+    assert np.array_equal(g_unit.weights, g_gen.weights)
