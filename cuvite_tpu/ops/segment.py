@@ -11,8 +11,18 @@ segment sums — everything static-shape, everything fused by XLA.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
+
+# CUVITE_DEBUG_BOUNDS is sampled ONCE, at import time: the bound check is
+# baked into traced step functions that are cached process-wide
+# (driver._STEP_CACHE keys don't include it), so flipping the env var
+# after the first compile could never take effect anyway.  Set it before
+# importing cuvite_tpu (i.e. before the first compile) or it is ignored.
+DEBUG_BOUNDS = os.environ.get("CUVITE_DEBUG_BOUNDS", "0").lower() \
+    not in ("", "0", "false")
 
 
 def segment_sum(data, segment_ids, num_segments, sorted_ids=False):
@@ -110,14 +120,13 @@ def sort_edges_by_vertex_comm(src, ckey, w, *extras, src_bound=None,
     bits; at kbits+sbits == 31 the int32 sign bit flips and the row sorts
     to the FRONT).  Callers pass src_bound = nv_local + 1 (padding rows
     carry src == nv_local) and key_bound = nv_total (community ids live in
-    padded vertex space).  Set CUVITE_DEBUG_BOUNDS=1 to verify at runtime
-    (host callback per sort — test/debug builds only).
+    padded vertex space).  Set CUVITE_DEBUG_BOUNDS=1 BEFORE the first
+    import/compile to verify at runtime (host callback per sort —
+    test/debug builds only; the flag is read once at module import into
+    DEBUG_BOUNDS, because traced steps are cached process-wide).
     """
     if src_bound is not None and key_bound is not None:
-        import os
-
-        if os.environ.get("CUVITE_DEBUG_BOUNDS", "0").lower() \
-                not in ("", "0", "false"):
+        if DEBUG_BOUNDS:
             def _check(smax, kmax):
                 if int(smax) >= int(src_bound) or int(kmax) >= int(key_bound):
                     raise AssertionError(

@@ -23,8 +23,16 @@ def enable_compile_cache(root: str | None = None) -> None:
     import jax
 
     if root is None:
-        root = os.path.dirname(os.path.dirname(os.path.dirname(
+        root = os.environ.get("CUVITE_COMPILE_CACHE_DIR")
+    if root is None:
+        # Repo-root heuristic: three dirs up from this file.  For a
+        # site-packages install that lands somewhere unwritable/shared, so
+        # fall back to a per-user cache dir.
+        cand = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
+        root = cand if os.access(cand, os.W_OK) else os.path.join(
+            os.environ.get("XDG_CACHE_HOME",
+                           os.path.expanduser("~/.cache")), "cuvite")
     jax.config.update("jax_compilation_cache_dir",
                       os.path.join(root, ".jax_cache"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

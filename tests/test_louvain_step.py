@@ -138,15 +138,20 @@ def test_first_step_two_cliques(two_cliques):
 
 def test_packed_sort_debug_bounds_guard(monkeypatch):
     """CUVITE_DEBUG_BOUNDS=1 turns packed-key bound violations into hard
-    errors instead of silent key corruption (advisor r2 finding)."""
+    errors instead of silent key corruption (advisor r2 finding).
+
+    The env var is read once at module import (advisor r3: a trace-time
+    read could never take effect after the step cache warms), so the test
+    toggles the module attribute directly."""
     import jax.numpy as jnp
 
+    from cuvite_tpu.ops import segment
     from cuvite_tpu.ops.segment import sort_edges_by_vertex_comm
 
     src = jnp.array([0, 1, 2], dtype=jnp.int32)
     ckey = jnp.array([0, 1, 9], dtype=jnp.int32)  # >= key_bound
     w = jnp.ones(3, dtype=jnp.float32)
-    monkeypatch.setenv("CUVITE_DEBUG_BOUNDS", "1")
+    monkeypatch.setattr(segment, "DEBUG_BOUNDS", True)
     with pytest.raises(AssertionError, match="bound violation"):
         sort_edges_by_vertex_comm(src, ckey, w, src_bound=4, key_bound=4)
     # In-bounds input passes and round-trips exactly.

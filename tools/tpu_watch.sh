@@ -1,0 +1,13 @@
+#!/bin/bash
+# Background watcher: probe the axon tunnel every ~10 min; on an alive
+# window run the full measurement ladder (tools/tpu_ladder.py).  Stops
+# when the ladder completes (tools/TPU_LADDER_DONE) or when
+# tools/TPU_WATCH_STOP exists.
+cd "$(dirname "$0")/.."
+while true; do
+  [ -f tools/TPU_LADDER_DONE ] && exit 0
+  [ -f tools/TPU_WATCH_STOP ] && exit 0
+  python tools/tpu_ladder.py >> tools/tpu_watch.out 2>&1
+  [ -f tools/TPU_LADDER_DONE ] && exit 0
+  sleep 600
+done
