@@ -226,47 +226,21 @@ def _pull_ghosts3(vals_a, vals_b, vals_c, send_idx, ghost_sel, axis_name):
             jnp.concatenate([vals_c, gc]))
 
 
-def sparse_env(comm, vdeg, send_idx, ghost_sel, axis_name, *,
-               nshards: int, budget: int) -> SparseEnv:
-    """Build the iteration's community state with sparse communication.
-
-    ``comm``/``vdeg`` are the shard's owned slices; ``send_idx`` [S, B] and
-    ``ghost_sel`` [G] come from the phase ExchangePlan.  Runs inside
-    shard_map over ``axis_name``.
-    """
-    S = nshards
-    nv_pad = comm.shape[0]
-    vdt = comm.dtype
-    wdt = vdeg.dtype
+def _group_by_community(vec, nv_pad, S, budget, base, sentinel):
+    """Sort-group a shard's owned community vector: returns the grouping
+    state shared by the accumulate and request flows (unique keys padded
+    with sentinel, run ids, inverse order, owner-route slots + overflow)."""
+    vdt = vec.dtype
     idt = jnp.int32
-    sentinel = jnp.iinfo(vdt).max
-    me = jax.lax.axis_index(axis_name).astype(vdt)
-    base = me * nv_pad
-
-    # --- owner-grouped unique communities of owned vertices ----------------
     iota = jnp.arange(nv_pad, dtype=vdt)
-    ck, order = jax.lax.sort((comm, iota), num_keys=1)
+    ck, order = jax.lax.sort((vec, iota), num_keys=1)
     lead = jnp.concatenate(
         [jnp.ones((1,), bool), ck[1:] != ck[:-1]])
     run_id = jnp.cumsum(lead.astype(idt)) - 1            # [nv_pad]
     uk = jnp.full((nv_pad,), sentinel, dtype=vdt).at[run_id].set(ck)
-    pdeg = seg.segment_sum(jnp.take(vdeg, order), run_id,
-                           num_segments=nv_pad, sorted_ids=True)
-    psize = seg.segment_sum(jnp.ones((nv_pad,), dtype=vdt), run_id,
-                            num_segments=nv_pad, sorted_ids=True)
-
     valid = uk != sentinel
     is_self = valid & (uk >= base) & (uk < base + nv_pad)
     is_remote = valid & ~is_self
-
-    # --- self-owned communities: accumulate locally, no communication ------
-    self_idx = jnp.where(is_self, (uk - base).astype(idt), nv_pad)
-    deg_local = jnp.zeros((nv_pad,), dtype=wdt).at[self_idx].add(
-        jnp.where(is_self, pdeg, 0), mode="drop")
-    size_local = jnp.zeros((nv_pad,), dtype=vdt).at[self_idx].add(
-        jnp.where(is_self, psize, 0), mode="drop")
-
-    # --- remote-owned: budgeted owner-route of (key, pdeg, psize) ----------
     # uk is sorted, so owner groups are contiguous; rank within group gives
     # the slot in the per-peer block.
     bnd = jnp.searchsorted(
@@ -276,13 +250,68 @@ def sparse_env(comm, vdeg, send_idx, ghost_sel, axis_name, *,
     slot = o_j * budget + rank
     ok = is_remote & (rank < budget)
     overflow = jnp.any(is_remote & (rank >= budget))
+    return uk, run_id, order, is_self, is_remote, slot, ok, overflow
+
+
+def sparse_env(comm, vdeg, send_idx, ghost_sel, axis_name, *,
+               nshards: int, budget: int, info=None) -> SparseEnv:
+    """Build the iteration's community state with sparse communication.
+
+    ``comm``/``vdeg`` are the shard's owned slices; ``send_idx`` [S, B] and
+    ``ghost_sel`` [G] come from the phase ExchangePlan.  Runs inside
+    shard_map over ``axis_name``.
+
+    ``info`` (optional FROZEN assignment, the vertex-ordering schedule):
+    community degree/size TABLES are accumulated by grouping ``info``,
+    while requests/attachment still follow ``comm`` — the sparse analog of
+    bucketed_step's replicated ``info_comm`` contract (tables frozen at
+    iteration start, /root/reference/louvain.cpp:1535-1562).  Costs one
+    extra owner-route collective over the fused info-is-comm flow.
+    """
+    S = nshards
+    nv_pad = comm.shape[0]
+    vdt = comm.dtype
+    wdt = vdeg.dtype
+    idt = jnp.int32
+    sentinel = jnp.iinfo(vdt).max
+    me = jax.lax.axis_index(axis_name).astype(vdt)
+    base = me * nv_pad
+    same_width_dt = jnp.dtype(vdt).itemsize == jnp.dtype(wdt).itemsize
+
+    # --- owner-grouped unique communities of owned vertices ----------------
+    (uk, run_id, order, is_self, is_remote, slot, ok,
+     overflow) = _group_by_community(comm, nv_pad, S, budget, base, sentinel)
+
+    if info is None:
+        acc_uk, acc_is_self, acc_slot, acc_ok = uk, is_self, slot, ok
+        acc_run_id, acc_order = run_id, order
+    else:
+        # Ordering: the deg/size tables come from the FROZEN assignment's
+        # grouping; the request grouping above stays on ``comm``.
+        (acc_uk, acc_run_id, acc_order, acc_is_self, _acc_rem, acc_slot,
+         acc_ok, ovf_i) = _group_by_community(
+            info, nv_pad, S, budget, base, sentinel)
+        overflow = overflow | ovf_i
+    pdeg = seg.segment_sum(jnp.take(vdeg, acc_order), acc_run_id,
+                           num_segments=nv_pad, sorted_ids=True)
+    psize = seg.segment_sum(jnp.ones((nv_pad,), dtype=vdt), acc_run_id,
+                            num_segments=nv_pad, sorted_ids=True)
+
+    # --- self-owned communities: accumulate locally, no communication ------
+    self_idx = jnp.where(acc_is_self, (acc_uk - base).astype(idt), nv_pad)
+    deg_local = jnp.zeros((nv_pad,), dtype=wdt).at[self_idx].add(
+        jnp.where(acc_is_self, pdeg, 0), mode="drop")
+    size_local = jnp.zeros((nv_pad,), dtype=vdt).at[self_idx].add(
+        jnp.where(acc_is_self, psize, 0), mode="drop")
+
+    # --- remote-owned: budgeted owner-route of (key, pdeg, psize) ----------
     oob = S * budget
-    sslot = jnp.where(ok, slot, oob)
-    send_key = jnp.full((S * budget,), sentinel, dtype=vdt).at[sslot].set(
-        uk, mode="drop")
-    send_deg = jnp.zeros((S * budget,), dtype=wdt).at[sslot].set(
+    acc_sslot = jnp.where(acc_ok, acc_slot, oob)
+    send_key = jnp.full((S * budget,), sentinel, dtype=vdt).at[acc_sslot].set(
+        acc_uk, mode="drop")
+    send_deg = jnp.zeros((S * budget,), dtype=wdt).at[acc_sslot].set(
         pdeg, mode="drop")
-    send_size = jnp.zeros((S * budget,), dtype=vdt).at[sslot].set(
+    send_size = jnp.zeros((S * budget,), dtype=vdt).at[acc_sslot].set(
         psize, mode="drop")
 
     # One collective for the 3-channel owner-route: key/size share the
@@ -293,7 +322,7 @@ def sparse_env(comm, vdeg, send_idx, ghost_sel, axis_name, *,
     # exchange from 7 all_to_all launches per iteration to 3
     # (VERDICT r2 item 5; cf. fillRemoteCommunities' single aggregated
     # protocol, /root/reference/louvain.cpp:2588-2959).
-    same_width = jnp.dtype(vdt).itemsize == jnp.dtype(wdt).itemsize
+    same_width = same_width_dt
     if same_width:
         fwd = jnp.stack([send_key.reshape(S, budget),
                          send_size.reshape(S, budget),
@@ -313,6 +342,18 @@ def sparse_env(comm, vdeg, send_idx, ghost_sel, axis_name, *,
     lk = (recv_key.reshape(-1) - base).astype(idt)  # sentinel -> OOB, dropped
     deg_local = deg_local.at[lk].add(recv_deg.reshape(-1), mode="drop")
     size_local = size_local.at[lk].add(recv_size.reshape(-1), mode="drop")
+
+    if info is not None:
+        # Separate request route: the accumulate collective above moved the
+        # FROZEN grouping's partials; the reply must answer the ``comm``
+        # grouping's keys (one extra key-only collective).
+        oob = S * budget
+        req_sslot = jnp.where(ok, slot, oob)
+        send_req = jnp.full((S * budget,), sentinel, dtype=vdt).at[
+            req_sslot].set(uk, mode="drop")
+        recv_req = jax.lax.all_to_all(
+            send_req.reshape(S, budget), axis_name, 0, 0, tiled=True)
+        lk = (recv_req.reshape(-1) - base).astype(idt)
 
     # --- reply with totals over the transposed routing ---------------------
     lk_safe = jnp.clip(lk, 0, nv_pad - 1)

@@ -129,7 +129,6 @@ class DistGraph:
             old_to_pad[lo:hi] = s * nv_pad + np.arange(hi - lo)
             pad_to_old[s * nv_pad : s * nv_pad + (hi - lo)] = np.arange(lo, hi)
 
-        sources = graph.sources().astype(np.int64)
         counts = [
             int(graph.offsets[parts[s + 1]] - graph.offsets[parts[s]])
             for s in range(nshards)
@@ -141,26 +140,44 @@ class DistGraph:
         vdt = graph.policy.vertex_dtype
         wdt = graph.policy.weight_dtype
         shards = []
-        for s in range(nshards):
-            e0 = int(graph.offsets[parts[s]])
-            e1 = int(graph.offsets[parts[s + 1]])
-            n = e1 - e0
-            src_l = np.full(ne_pad, nv_pad, dtype=vdt)  # out-of-range pad
+        if nshards == 1:
+            # Single shard: the padded id space IS the original id space
+            # (old_to_pad = identity), so the generic path's O(E) int64
+            # expand + two fancy-index remaps reduce to plain copies in the
+            # device dtype — this runs once per phase and was a visible
+            # slice of benchmark-scale host time.
+            n = graph.num_edges
+            src_l = np.full(ne_pad, nv_pad, dtype=vdt)
             dst_g = np.zeros(ne_pad, dtype=vdt)
             w = np.zeros(ne_pad, dtype=wdt)
-            src_l[:n] = (old_to_pad[sources[e0:e1]] - s * nv_pad).astype(vdt)
-            dst_g[:n] = old_to_pad[graph.tails[e0:e1].astype(np.int64)].astype(vdt)
-            w[:n] = graph.weights[e0:e1]
-            shards.append(
-                Shard(
-                    base=int(parts[s]),
-                    bound=int(parts[s + 1]),
-                    src=src_l,
-                    dst=dst_g,
-                    w=w,
-                    n_real_edges=n,
+            src_l[:n] = np.repeat(
+                np.arange(nv, dtype=vdt), graph.degrees())
+            dst_g[:n] = graph.tails
+            w[:n] = graph.weights
+            shards.append(Shard(base=0, bound=nv, src=src_l, dst=dst_g,
+                                w=w, n_real_edges=n))
+        else:
+            sources = graph.sources().astype(np.int64)
+            for s in range(nshards):
+                e0 = int(graph.offsets[parts[s]])
+                e1 = int(graph.offsets[parts[s + 1]])
+                n = e1 - e0
+                src_l = np.full(ne_pad, nv_pad, dtype=vdt)  # out-of-range pad
+                dst_g = np.zeros(ne_pad, dtype=vdt)
+                w = np.zeros(ne_pad, dtype=wdt)
+                src_l[:n] = (old_to_pad[sources[e0:e1]] - s * nv_pad).astype(vdt)
+                dst_g[:n] = old_to_pad[graph.tails[e0:e1].astype(np.int64)].astype(vdt)
+                w[:n] = graph.weights[e0:e1]
+                shards.append(
+                    Shard(
+                        base=int(parts[s]),
+                        bound=int(parts[s + 1]),
+                        src=src_l,
+                        dst=dst_g,
+                        w=w,
+                        n_real_edges=n,
+                    )
                 )
-            )
         return DistGraph(
             graph=graph,
             parts=parts,

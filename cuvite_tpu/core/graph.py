@@ -50,6 +50,13 @@ class Graph:
     def weighted_degrees(self) -> np.ndarray:
         """Per-vertex sum of incident edge weights, self-loops included
         (cf. distSumVertexDegree, /root/reference/louvain.cpp:2126-2151)."""
+        from cuvite_tpu import native
+
+        if self.num_edges >= native.MIN_NATIVE_EDGES and native.available():
+            # Same f64 slab-order accumulation, without materializing the
+            # expanded O(E) source array + f64 weight copy.
+            return native.weighted_degrees(
+                self.offsets, self.weights).astype(self.policy.weight_dtype)
         return np.bincount(
             self.sources(), weights=self.weights.astype(np.float64),
             minlength=self.num_vertices,

@@ -37,6 +37,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 LANE = 128
 DEFAULT_TILE_N = 512
+# Width above which the candidate loop switches from full unroll to
+# lax.fori_loop (bounding compile time; identical arithmetic).  The
+# unrolled form lets Mosaic schedule the small widths tightest.
+UNROLL_MAX_WIDTH = 32
+# Per-tile VMEM budget for the [D, T] operand blocks (c/w/ay + outputs),
+# used to shrink the row tile for wide classes: 3 f32 blocks of
+# D x tile_n must fit comfortably under ~16 MB v5e VMEM.
+VMEM_BUDGET_BYTES = 6 << 20
 
 
 def _kernel(const_ref, cT_ref, wT_ref, ayT_ref, curr_ref, vdeg_ref, sl_ref,
@@ -60,25 +68,49 @@ def _kernel(const_ref, cT_ref, wT_ref, ayT_ref, curr_ref, vdeg_ref, sl_ref,
     eix = c0 - sl
 
     neg_inf = jnp.full(curr.shape, -jnp.inf, dtype=wdt)
-    bg = neg_inf
-    bc = jnp.full(curr.shape, sentinel, dtype=c.dtype)
+    bg0 = neg_inf
+    bc0 = jnp.full(curr.shape, sentinel, dtype=c.dtype)
     two_vdeg_const = 2.0 * vdeg * const
-    for j in range(width):
-        cj = c[j : j + 1, :]
-        eq = c == cj
+
+    def step_j(cj, ayj, eq, dup_j, bc, bg):
+        """One candidate slot: aggregate duplicates, gain, running argmax.
+        Shared by the unrolled (static j) and fori_loop (traced j) forms —
+        identical arithmetic, so the two are bit-identical."""
         wagg_j = jnp.sum(jnp.where(eq, w, zero), axis=0, keepdims=True)
-        if j > 0:
-            dup_j = jnp.any(eq[:j, :], axis=0, keepdims=True)
-            valid_j = (~dup_j) & (~is_cc[j : j + 1, :])
-        else:
-            valid_j = ~is_cc[j : j + 1, :]
-        gain_j = 2.0 * (wagg_j - eix) \
-            - two_vdeg_const * (ay[j : j + 1, :] - ax)
+        valid_j = (~dup_j) & (cj != curr) if dup_j is not None \
+            else (cj != curr)
+        gain_j = 2.0 * (wagg_j - eix) - two_vdeg_const * (ayj - ax)
         gain_j = jnp.where(valid_j, gain_j, neg_inf)
         better = gain_j > bg
         tie = valid_j & (gain_j == bg)
         bc = jnp.where(better, cj, jnp.where(tie, jnp.minimum(bc, cj), bc))
         bg = jnp.maximum(bg, gain_j)
+        return bc, bg
+
+    if width <= UNROLL_MAX_WIDTH:
+        bc, bg = bc0, bg0
+        for j in range(width):
+            cj = c[j : j + 1, :]
+            eq = c == cj
+            dup_j = (jnp.any(eq[:j, :], axis=0, keepdims=True)
+                     if j > 0 else None)
+            bc, bg = step_j(cj, ay[j : j + 1, :], eq, dup_j, bc, bg)
+    else:
+        # Wide classes: loop over candidate slots with dynamic sublane
+        # slices (compile time O(1) in width).  The duplicate-leader test
+        # uses a row-index mask (rows k < j) on the full eq matrix.
+        D, T = c.shape
+        row_idx = jax.lax.broadcasted_iota(jnp.int32, (D, T), 0)
+
+        def body(j, carry):
+            bc, bg = carry
+            cj = jax.lax.dynamic_slice_in_dim(c, j, 1, axis=0)
+            ayj = jax.lax.dynamic_slice_in_dim(ay, j, 1, axis=0)
+            eq = c == cj
+            dup_j = jnp.any(eq & (row_idx < j), axis=0, keepdims=True)
+            return step_j(cj, ayj, eq, dup_j, bc, bg)
+
+        bc, bg = jax.lax.fori_loop(0, width, body, (bc0, bg0))
     bc_ref[:] = bc
     bg_ref[:] = bg
 
@@ -94,12 +126,18 @@ def row_argmax_pallas(cT, wT, ayT, curr, vdeg, sl, ax, constant, *,
 
     cT/wT/ayT: [D, N] transposed bucket matrices; curr/vdeg/sl/ax: [N]
     (sl = per-vertex self-loop weight); constant: scalar.  N must be a
-    multiple of ``tile_n`` (bucket row counts are padded to powers of two
-    >= 128 by the runner for this path).  Returns
+    multiple of the row tile (bucket row counts are padded to powers of
+    two >= 128 by the runner for this path).  The tile shrinks below
+    ``tile_n`` for wide D so the three [D, tile] f32 operand blocks stay
+    inside the VMEM budget.  Returns
     (best_c [N] int, best_gain [N], counter0 [N]).
     """
     D, N = cT.shape
     tile = min(tile_n, N)
+    # Wide classes: bound 3 * D * tile * 4B by the VMEM budget (pow2
+    # shrink keeps N % tile == 0 — both are powers of two >= 128).
+    while tile > LANE and 3 * D * tile * 4 > VMEM_BUDGET_BYTES:
+        tile //= 2
     assert N % tile == 0 and tile % LANE == 0, (N, tile)
     grid = (N // tile,)
 

@@ -75,6 +75,13 @@ def _env_int(name: str, default: int) -> int:
 # units tolerate much larger D^2 than a scalar CPU does — so it is a
 # load-time knob rather than a constant.
 QUADRATIC_MAX_WIDTH = _env_int("CUVITE_QUAD_MAX", 32)
+# Widest degree class routed through the Pallas row-argmax kernel by
+# engine='pallas' (the XLA paths handle anything wider).  The kernel
+# switches from an unrolled candidate loop to lax.fori_loop above
+# kernels.row_argmax.UNROLL_MAX_WIDTH and shrinks its row tile to honor
+# VMEM; 2048 keeps the [D, tile] blocks comfortably resident.  Knob for
+# on-chip A/B ladders.
+PALLAS_MAX_WIDTH = _env_int("CUVITE_PALLAS_MAX", 2048)
 ROW_CHUNK = _env_int("CUVITE_ROW_CHUNK", 8192)  # rows/lax.map step (quad)
 # rows*width per lax.map step for the sorted dedup classes:
 ROW_ELEMS_CHUNK = _env_int("CUVITE_ROW_ELEMS", 1 << 22)
@@ -364,20 +371,24 @@ def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
         return np.where((src < nvl) & in_cls, src, nvl).astype(src.dtype)
 
     if exchange_plan is not None:
-        assert class_of is None, \
-            "class-restricted plans are a replicated-exchange feature"
-        plans = [
-            BucketPlan.build(
-                np.asarray(dg.shards[s].src),
+        # Class-restricted sparse plans (reference's distributed -c/-d,
+        # /root/reference/louvain.cpp:862-901): the ghost ROUTING is
+        # class-independent — every class plan shares the phase's
+        # send_idx/ghost_sel and extended-local dst space — only the row
+        # masking differs.  remap_dst sees the MASKED src, so masked-out
+        # edges map to dst 0 and are dropped as padding.
+        def _sparse_plan(s):
+            ms = _mask_src(s)   # one O(E) masking pass, shared
+            return BucketPlan.build(
+                ms,
                 exchange_plan.remap_dst(
-                    s, np.asarray(dg.shards[s].src),
-                    np.asarray(dg.shards[s].dst)
+                    s, ms, np.asarray(dg.shards[s].dst)
                 ).astype(np.asarray(dg.shards[s].dst).dtype),
                 np.asarray(dg.shards[s].w),
                 nv_local=nvl, base=0, widths=widths,
             )
-            for s in sids
-        ]
+
+        plans = [_sparse_plan(s) for s in sids]
     else:
         plans = [
             BucketPlan.build(
@@ -677,17 +688,32 @@ def _rows_chunked(cmat, w_mat, dst_mat, curr, vdeg_v, sl_v, ax_v,
 
 def bucketed_modularity(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
                         constant, *, nv_total, accum_dtype=None,
-                        axis_name=None):
+                        axis_name=None, sparse_plan=None, nshards=1,
+                        budget=0):
     """Modularity of ``comm`` alone (no argmax): one cheap masked-sum pass
     over the bucket rows + heavy slab.  Used by the color-scheduled
     iteration, whose per-class steps see partial states — this gives the
     iteration's Q at its START state for the convergence check at ~the cost
     of the counter0 pass.  With ``axis_name`` it runs SPMD inside shard_map
-    (replicated exchange: all_gather'ed community vector, psum'd terms)."""
+    (replicated exchange: all_gather'ed community vector, psum'd terms).
+
+    With ``sparse_plan`` the pass rides the sparse ghost exchange instead
+    (dst ids extended-local, owner-sharded a² term) and RETURNS
+    ``(modularity, overflow)`` — the budgeted owner-reduce behind the a²
+    term can overflow exactly like the step's."""
     nv_local = comm.shape[0]
     wdt = vdeg.dtype
-    comm_full, gsum = seg.spmd_env(comm, axis_name)
-    comm_deg = gsum(seg.segment_sum(vdeg, comm, num_segments=nv_total))
+    use_sparse = sparse_plan is not None
+    if use_sparse:
+        from cuvite_tpu.comm.exchange import sparse_env, sparse_modularity
+
+        assert axis_name is not None, "sparse exchange requires a mesh axis"
+        env = sparse_env(comm, vdeg, sparse_plan[0], sparse_plan[1],
+                         axis_name, nshards=nshards, budget=budget)
+        comm_full = env.comm_ext
+    else:
+        comm_full, gsum = seg.spmd_env(comm, axis_name)
+        comm_deg = gsum(seg.segment_sum(vdeg, comm, num_segments=nv_total))
     counter0 = jnp.zeros((nv_local,), dtype=wdt)
     hs, hd, hw = heavy_arrays
     ckey_h = jnp.take(comm_full, hd)
@@ -706,6 +732,12 @@ def bucketed_modularity(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
             jnp.where(cmat == curr[:, None], w_mat, 0.0), axis=1
         ).astype(wdt)
         counter0 = counter0.at[verts].add(c0_rows, mode="drop")
+    if use_sparse:
+        mod = sparse_modularity(counter0, env.deg_local, constant,
+                                axis_name, accum_dtype)
+        overflow = jax.lax.psum(env.overflow.astype(jnp.int32),
+                                axis_name) > 0
+        return mod, overflow
     return seg.modularity_terms(counter0, comm_deg, constant,
                                 gsum, accum_dtype, axis_name=axis_name)
 
@@ -762,8 +794,6 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
     vdt = comm.dtype
 
     use_sparse = sparse_plan is not None
-    assert info_comm is None or not use_sparse, \
-        "info_comm (vertex ordering) is a replicated-exchange feature"
     if use_sparse:
         from cuvite_tpu.comm.exchange import sparse_env, sparse_modularity
 
@@ -771,7 +801,8 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
         assert not any(pallas_flags or ()), \
             "pallas buckets are single-shard only"
         env = sparse_env(comm, vdeg, sparse_plan[0], sparse_plan[1],
-                         axis_name, nshards=nshards, budget=budget)
+                         axis_name, nshards=nshards, budget=budget,
+                         info=info_comm)
         comm_ref = env.comm_ext      # gather table for dst indices
 
         def gsum(x):
@@ -938,59 +969,92 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
 
 
 def make_sharded_class_step(mesh, axis_name: str, n_buckets: int,
-                            nv_total: int, sentinel: int, accum_dtype=None):
+                            nv_total: int, sentinel: int, accum_dtype=None,
+                            sparse=None, ordering: bool = False):
     """Jit one color class's restricted sweep as a shard_map: like
-    make_sharded_bucketed_step (replicated exchange only) but taking a
-    separate ``info_comm`` — the community-info state the class's gains are
-    computed against.  Coloring passes the committed work vector (info
-    refreshed per class, /root/reference/louvain.cpp:862-901); vertex
-    ordering passes the iteration-start snapshot (exchanges hoisted out of
-    the color loop, louvain.cpp:1535-1562)."""
+    make_sharded_bucketed_step but taking a separate ``info_comm`` — the
+    community-info state the class's gains are computed against.  Coloring
+    passes the committed work vector (info refreshed per class,
+    /root/reference/louvain.cpp:862-901); vertex ordering passes the
+    iteration-start snapshot (exchanges hoisted out of the color loop,
+    louvain.cpp:1535-1562).
+
+    ``sparse=(nshards, budget)`` runs the class sweep over the sparse ghost
+    exchange (two trailing plan arrays, exactly as in
+    make_sharded_bucketed_step); the 4th output is then the live
+    budget-overflow flag.  Ordering's frozen info rides the exchange's
+    ``info`` mode (one extra collective per class sweep)."""
     bspec = tuple((P(axis_name), P(axis_name), P(axis_name))
                   for _ in range(n_buckets))
     hspec = (P(axis_name), P(axis_name), P(axis_name))
-    in_specs = (bspec, hspec, P(axis_name), P(axis_name), P(axis_name),
-                P(axis_name), P(), P(axis_name))
+    in_specs = [bspec, hspec, P(axis_name), P(axis_name), P(axis_name),
+                P(axis_name), P(), P(axis_name)]
     out_specs = (P(axis_name), P(), P(), P())
+    if sparse is not None:
+        nshards, budget = sparse
+        in_specs += [P(axis_name), P(axis_name)]
+    else:
+        nshards, budget = 1, 0
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=in_specs,
+        in_specs=tuple(in_specs),
         out_specs=out_specs,
         check_vma=False,
     )
     def step(bucket_arrays, heavy_arrays, self_loop, comm, info_comm, vdeg,
-             constant, perm):
+             constant, perm, *plan):
+        # ``ordering`` is a STATIC trait of the schedule: coloring passes
+        # info == work (community info refreshed per class), so the frozen
+        # info plumbing — and the sparse env's extra collective — is
+        # compiled out entirely rather than detected at trace time.
         return bucketed_step(
             bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant,
             nv_total=nv_total, sentinel=sentinel, accum_dtype=accum_dtype,
-            axis_name=axis_name, info_comm=info_comm, assemble_perm=perm,
+            axis_name=axis_name,
+            info_comm=info_comm if ordering else None,
+            sparse_plan=plan if plan else None,
+            nshards=nshards, budget=budget,
+            assemble_perm=perm,
         )
 
     return jax.jit(step)
 
 
 def make_sharded_bucketed_mod(mesh, axis_name: str, n_buckets: int,
-                              nv_total: int, accum_dtype=None):
+                              nv_total: int, accum_dtype=None, sparse=None):
     """Jit the counter0-only modularity pass as a shard_map (the SPMD
-    convergence check for the class-scheduled iteration)."""
+    convergence check for the class-scheduled iteration).  With
+    ``sparse=(nshards, budget)`` it rides the sparse exchange and returns
+    ``(modularity, overflow)``."""
     bspec = tuple((P(axis_name), P(axis_name), P(axis_name))
                   for _ in range(n_buckets))
     hspec = (P(axis_name), P(axis_name), P(axis_name))
+    in_specs = [bspec, hspec, P(axis_name), P(axis_name), P(axis_name),
+                P()]
+    if sparse is not None:
+        nshards, budget = sparse
+        in_specs += [P(axis_name), P(axis_name)]
+        out_specs = (P(), P())
+    else:
+        nshards, budget = 1, 0
+        out_specs = P()
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(bspec, hspec, P(axis_name), P(axis_name), P(axis_name),
-                  P()),
-        out_specs=P(),
+        in_specs=tuple(in_specs),
+        out_specs=out_specs,
         check_vma=False,
     )
-    def mod(bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant):
+    def mod(bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant,
+            *plan):
         return bucketed_modularity(
             bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant,
             nv_total=nv_total, accum_dtype=accum_dtype, axis_name=axis_name,
+            sparse_plan=plan if plan else None,
+            nshards=nshards, budget=budget,
         )
 
     return jax.jit(mod)

@@ -92,6 +92,11 @@ def _bind(lib: ctypes.CDLL) -> None:
                                    ctypes.POINTER(vp), ctypes.POINTER(vp),
                                    ctypes.POINTER(vp), ctypes.c_int, i64,
                                    vp, vp, vp]
+    lib.cv_coarsen.restype = i64
+    lib.cv_coarsen.argtypes = [i64, i64, p_i64, vp, vp, ctypes.c_int,
+                               ctypes.c_int, p_i32, p_i64, p_i32, p_f32]
+    lib.cv_weighted_degrees.restype = None
+    lib.cv_weighted_degrees.argtypes = [i64, p_i64, vp, ctypes.c_int, p_f64]
 
 
 def _load():
@@ -233,6 +238,49 @@ def balanced_parts(offsets: np.ndarray, nparts: int) -> np.ndarray:
 
 def _vp(a: np.ndarray):
     return ctypes.c_void_p(a.ctypes.data)
+
+
+def coarsen_csr(offsets: np.ndarray, tails: np.ndarray, weights: np.ndarray,
+                labels: np.ndarray, nc: int):
+    """Fused relabel + coalesce of a CSR graph into its community graph
+    (see cv_coarsen).  Returns (offsets[i64], tails[i32], weights[f32]);
+    requires nc <= 2^31.  Bit-identical to relabel + Graph.from_edges
+    (symmetrize=False, f32 weight policy)."""
+    lib = _load()
+    assert lib is not None
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    tails = np.ascontiguousarray(tails)
+    assert tails.dtype in (np.int32, np.int64), tails.dtype
+    weights = np.ascontiguousarray(weights)
+    if weights.dtype not in (np.float32, np.float64):
+        weights = weights.astype(np.float32)
+    labels = np.ascontiguousarray(labels, dtype=np.int32)
+    cap = max(len(tails), 1)
+    offsets_out = np.empty(nc + 1, dtype=np.int64)
+    tails_out = np.empty(cap, dtype=np.int32)
+    wout = np.empty(cap, dtype=np.float32)
+    n = lib.cv_coarsen(len(offsets) - 1, nc, offsets, _vp(tails),
+                       _vp(weights), int(tails.dtype == np.int64),
+                       int(weights.dtype == np.float64), labels,
+                       offsets_out, tails_out, wout)
+    if n < 0:
+        raise ValueError("cv_coarsen: label out of range or nc > 2^31")
+    return offsets_out, tails_out[:n].copy(), wout[:n].copy()
+
+
+def weighted_degrees(offsets: np.ndarray, weights: np.ndarray) -> np.ndarray:
+    """Per-vertex f64 weighted degree off the CSR (see cv_weighted_degrees);
+    bit-identical to np.bincount(sources, weights=w.astype(f64))."""
+    lib = _load()
+    assert lib is not None
+    offsets = np.ascontiguousarray(offsets, dtype=np.int64)
+    weights = np.ascontiguousarray(weights)
+    if weights.dtype not in (np.float32, np.float64):
+        weights = weights.astype(np.float64)
+    out = np.empty(len(offsets) - 1, dtype=np.float64)
+    lib.cv_weighted_degrees(len(offsets) - 1, offsets, _vp(weights),
+                            int(weights.dtype == np.float64), out)
+    return out
 
 
 def plan_scan(src, dst, w, nv: int, base: int):

@@ -30,9 +30,11 @@ def _bucket_case(n_rows, width, nv, seed):
     return cmat, wmat, curr, vdeg, sl, comm_deg, constant
 
 
-@pytest.mark.parametrize("width", [8, 32])
+@pytest.mark.parametrize("width", [8, 32, 64, 256])
 @pytest.mark.parametrize("seed", [0, 3])
 def test_row_argmax_pallas_matches_xla(width, seed):
+    """Widths 8/32 exercise the unrolled candidate loop; 64/256 the
+    fori_loop form added for the wide classes (VERDICT r3 item 4)."""
     n_rows, nv = 256, 500
     cmat, wmat, curr, vdeg, sl, comm_deg, constant = _bucket_case(
         n_rows, width, nv, seed)

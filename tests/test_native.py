@@ -387,3 +387,121 @@ def test_from_edges_unit_dispatch():
     assert np.array_equal(g_unit.offsets, g_gen.offsets)
     assert np.array_equal(g_unit.tails, g_gen.tails)
     assert np.array_equal(g_unit.weights, g_gen.weights)
+
+
+def _coarsen_ref(g, dense, nc):
+    """The numpy coarsen route (relabel + generic from_edges), native off."""
+    from cuvite_tpu.core.graph import Graph
+
+    old = native._LIB
+    native._LIB = False
+    try:
+        s2 = dense[g.sources()]
+        d2 = dense[g.tails.astype(np.int64)]
+        return Graph.from_edges(nc, s2, d2,
+                                weights=g.weights.astype(np.float64),
+                                symmetrize=False)
+    finally:
+        native._LIB = old
+
+
+@pytest.mark.parametrize("nc_target", [100, 2500])
+def test_coarsen_csr_matches_numpy(nc_target):
+    """cv_coarsen (small-nc dense-accumulator path) is bit-identical to
+    relabel + Graph.from_edges."""
+    from cuvite_tpu.core.graph import Graph
+    from cuvite_tpu.coarsen.rebuild import renumber_communities
+
+    rng = np.random.default_rng(3)
+    nv, ne = 3000, 20000
+    src = rng.integers(0, nv, size=ne)
+    dst = rng.integers(0, nv, size=ne)
+    w = rng.integers(1, 32, size=ne) / 16.0
+    g = Graph.from_edges(nv, src, dst, weights=w, symmetrize=True)
+    dense, nc = renumber_communities(rng.integers(0, nc_target, size=nv))
+    ref = _coarsen_ref(g, dense, nc)
+    off, tails, wout = native.coarsen_csr(
+        g.offsets, g.tails, g.weights, dense, nc)
+    assert np.array_equal(off, ref.offsets)
+    assert np.array_equal(tails, ref.tails)
+    assert np.array_equal(wout, ref.weights)
+
+
+def test_coarsen_csr_radix_branch():
+    """nc > 2^22 forces cv_coarsen's LSD-radix branch; bit-identity must
+    hold there too (production coarsen of phase-0 benchmark graphs)."""
+    from cuvite_tpu.core.graph import Graph
+    from cuvite_tpu.coarsen.rebuild import renumber_communities
+
+    rng = np.random.default_rng(4)
+    nv, ne = 9_000_000, 120_000
+    src = rng.integers(0, nv, size=ne)
+    dst = rng.integers(0, nv, size=ne)
+    g = Graph.from_edges(nv, src, dst, symmetrize=True)
+    dense, nc = renumber_communities(rng.integers(0, 8_500_000, size=nv))
+    assert nc > 1 << 22  # radix branch precondition
+    ref = _coarsen_ref(g, dense, nc)
+    off, tails, wout = native.coarsen_csr(
+        g.offsets, g.tails, g.weights, dense, nc)
+    assert np.array_equal(off, ref.offsets)
+    assert np.array_equal(tails, ref.tails)
+    assert np.array_equal(wout, ref.weights)
+
+
+def test_coarsen_graph_dispatch():
+    """coarsen_graph above the size threshold must take the native fused
+    path and produce the exact same Graph as the numpy route."""
+    from cuvite_tpu.core.graph import Graph
+    from cuvite_tpu.coarsen.rebuild import coarsen_graph, renumber_communities
+
+    rng = np.random.default_rng(5)
+    nv = 1 << 12
+    ne = native.MIN_NATIVE_EDGES + 41
+    src = rng.integers(0, nv, ne)
+    dst = rng.integers(0, nv, ne)
+    g = Graph.from_edges(nv, src, dst)
+    assert g.num_edges >= native.MIN_NATIVE_EDGES
+    dense, nc = renumber_communities(rng.integers(0, 500, size=nv))
+    got = coarsen_graph(g, dense, nc)
+    ref = _coarsen_ref(g, dense, nc)
+    assert np.array_equal(got.offsets, ref.offsets)
+    assert np.array_equal(got.tails, ref.tails)
+    assert np.array_equal(got.weights, ref.weights)
+
+
+def test_weighted_degrees_native_matches_numpy():
+    from cuvite_tpu.core.graph import Graph
+
+    rng = np.random.default_rng(6)
+    nv, ne = 5000, 70000
+    src = rng.integers(0, nv, size=ne)
+    dst = rng.integers(0, nv, size=ne)
+    w = rng.random(ne)
+    g = Graph.from_edges(nv, src, dst, weights=w, symmetrize=True)
+    ref = np.bincount(g.sources(), weights=g.weights.astype(np.float64),
+                      minlength=nv).astype(g.policy.weight_dtype)
+    assert np.array_equal(g.weighted_degrees(), ref)
+
+
+def test_distgraph_single_shard_fast_path():
+    """The nshards=1 identity fast path must produce the same slabs as the
+    generic remap route (checked against directly computed expectations)."""
+    from cuvite_tpu.core.distgraph import DistGraph
+    from cuvite_tpu.core.graph import Graph
+
+    rng = np.random.default_rng(7)
+    nv, ne = 1000, 8000
+    src = rng.integers(0, nv, size=ne)
+    dst = rng.integers(0, nv, size=ne)
+    g = Graph.from_edges(nv, src, dst, weights=rng.random(ne))
+    dg = DistGraph.build(g, 1)
+    sh = dg.shards[0]
+    n = g.num_edges
+    assert sh.n_real_edges == n
+    assert np.array_equal(sh.src[:n],
+                          g.sources().astype(sh.src.dtype))
+    assert np.array_equal(sh.dst[:n], g.tails.astype(sh.dst.dtype))
+    assert np.array_equal(sh.w[:n], g.weights)
+    assert np.all(sh.src[n:] == dg.nv_pad)
+    assert np.all(sh.w[n:] == 0)
+    assert np.array_equal(dg.old_to_pad, np.arange(nv))
