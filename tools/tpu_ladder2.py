@@ -1,0 +1,181 @@
+"""Second-stage TPU ladder (round 4) — run AFTER tools/tpu_ladder.py.
+
+The first ladder proves the narrow-width compiled Pallas kernel and lands
+BENCH-ready platform=tpu JSON at scales 18/20.  This one spends the same
+alive window on the remaining chip-gated claims, cheapest-first so a
+mid-ladder wedge preserves the most valuable results:
+
+  A2. compiled Pallas parity + min-of-5 timing for the WIDE classes
+      (64/256/2048 — the lax.fori_loop + shrunken-tile path that has only
+      ever run in interpret mode) vs the XLA sorted-dedup twin;
+  D.  full clustering A/B on chip: engine=bucketed (XLA) vs
+      engine=pallas, rmat-18 and rmat-20, modularity + wall from --json;
+  E.  bench.py at scale 22 (platform=tpu JSON line for the record).
+
+Every result appends to tools/tpu_ladder_r4.log immediately.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+LOG = os.path.join(REPO, "tools", "tpu_ladder_r4.log")
+
+
+def log(msg):
+    line = f"[{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}] {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def stage_a2(jnp, np):
+    from cuvite_tpu.kernels.row_argmax import row_argmax_pallas
+    from cuvite_tpu.louvain.bucketed import _row_argmax_sorted
+
+    SENT = np.iinfo(np.int32).max
+    rng = np.random.default_rng(0)
+    for width, n_rows in ((64, 1 << 14), (256, 1 << 13), (2048, 1 << 11)):
+        nv = 50000
+        cmat = rng.integers(0, nv, size=(n_rows, width)).astype(np.int32)
+        wmat = (rng.integers(1, 32, size=(n_rows, width)) / 16.0
+                ).astype(np.float32)
+        curr = rng.integers(0, nv, size=n_rows).astype(np.int32)
+        cmat[: n_rows // 2, 0] = curr[: n_rows // 2]
+        vdeg = (rng.integers(1, 64, size=n_rows) / 4.0).astype(np.float32)
+        sl = np.where(cmat[:, 0] == curr, wmat[:, 0] / 2.0, 0.0
+                      ).astype(np.float32)
+        comm_deg = (rng.integers(1, 256, size=nv) / 8.0).astype(np.float32)
+        const = np.float32(1.0 / 64.0)
+        ay = comm_deg[cmat]
+        ax = comm_deg[curr] - vdeg
+        args_p = (jnp.asarray(np.ascontiguousarray(cmat.T)),
+                  jnp.asarray(np.ascontiguousarray(wmat.T)),
+                  jnp.asarray(np.ascontiguousarray(ay.T)),
+                  jnp.asarray(curr), jnp.asarray(vdeg), jnp.asarray(sl),
+                  jnp.asarray(ax), jnp.asarray(const))
+        args_x = (jnp.asarray(cmat), jnp.asarray(wmat), jnp.asarray(ay),
+                  None, jnp.asarray(curr), jnp.asarray(vdeg),
+                  jnp.asarray(sl), jnp.asarray(ax), jnp.asarray(const),
+                  SENT)
+
+        t0 = time.perf_counter()
+        bc, bg, c0 = row_argmax_pallas(*args_p, sentinel=SENT,
+                                       interpret=False)
+        bc_h = np.asarray(bc)
+        log(f"A2: width={width} pallas COMPILED ok "
+            f"(first call {time.perf_counter()-t0:.1f}s)")
+        ref = _row_argmax_sorted(*args_x, id_bound=nv)
+        # The sorted XLA twin and the kernel agree exactly on best_c and
+        # counter0; best_gain may differ in f32 summation order for
+        # duplicate aggregation, so compare it with an epsilon.
+        ok_c = (np.array_equal(bc_h, np.asarray(ref.best_c))
+                and np.array_equal(np.asarray(c0), np.asarray(ref.counter0)))
+        gmax = float(np.max(np.abs(
+            np.where(np.isfinite(np.asarray(bg)),
+                     np.asarray(bg) - np.asarray(ref.best_gain), 0.0))))
+        log(f"A2: width={width} vs XLA-sorted: best_c/counter0 "
+            f"{'PASS' if ok_c else 'FAIL'}, |dgain|max={gmax:.3g}")
+
+        def t5(fn):
+            ts = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                out = fn()
+                _ = float(np.asarray(out[0]).ravel()[0])
+                ts.append(time.perf_counter() - t0)
+            return min(ts)
+
+        tp = t5(lambda: row_argmax_pallas(*args_p, sentinel=SENT,
+                                          interpret=False))
+        tx = t5(lambda: _row_argmax_sorted(*args_x, id_bound=nv))
+        log(f"A2: width={width} rows={n_rows}: pallas {tp*1e3:.2f} ms vs "
+            f"XLA-sorted {tx*1e3:.2f} ms ({tx/max(tp,1e-9):.2f}x)")
+
+
+def stage_d(platform):
+    for scale in (18, 20):
+        for engine in ("bucketed", "pallas"):
+            cmd = [sys.executable, "-m", "cuvite_tpu.cli",
+                   "--rmat", str(scale), "--engine", engine,
+                   "--platform", platform, "--json", "--quiet"]
+            t0 = time.perf_counter()
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=2400, cwd=REPO)
+            wall = time.perf_counter() - t0
+            line = ""
+            for ln in reversed(out.stdout.strip().splitlines() or [""]):
+                if ln.startswith("{"):
+                    line = ln
+                    break
+            log(f"D: scale={scale} engine={engine} rc={out.returncode} "
+                f"wall={wall:.0f}s json={line or out.stderr[-200:]}")
+
+
+def stage_e():
+    env = dict(os.environ, BENCH_SCALE="22", BENCH_TIME_BUDGET="1500",
+               BENCH_REPEATS="2")
+    t0 = time.perf_counter()
+    out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                         capture_output=True, text=True, timeout=3600,
+                         env=env)
+    last = out.stdout.strip().splitlines()
+    log(f"E: bench scale=22 rc={out.returncode} "
+        f"wall={time.perf_counter()-t0:.0f}s "
+        f"json={last[-1] if last else '?'}")
+    if out.returncode == 0 and last:
+        try:
+            j = json.loads(last[-1])
+            if j.get("platform") != "cpu":
+                with open(os.path.join(REPO, "tools/bench_tpu_s22_r4.json"),
+                          "w") as f:
+                    f.write(last[-1] + "\n")
+        except json.JSONDecodeError:
+            pass
+
+
+def main():
+    import jax
+
+    try:
+        d = jax.devices()
+    except Exception as e:
+        print(f"no devices: {e}", flush=True)
+        return 2
+    from jax._src import xla_bridge as xb
+
+    names = [k for k, b in xb.backends().items() if b is d[0].client]
+    plat = names[0] if names else d[0].platform
+    if plat == "cpu":
+        log("ladder2: backend is cpu; nothing to measure")
+        return 2
+    jax.config.update("jax_platforms", plat)
+    from cuvite_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    import jax.numpy as jnp
+    import numpy as np
+
+    log(f"LADDER2 start: backend={plat} devices={jax.devices()}")
+    try:
+        stage_a2(jnp, np)
+    except Exception as e:
+        log(f"A2: FAILED {type(e).__name__}: {e}")
+    try:
+        stage_d(plat)
+    except Exception as e:
+        log(f"D: FAILED {type(e).__name__}: {e}")
+    try:
+        stage_e()
+    except Exception as e:
+        log(f"E: FAILED {type(e).__name__}: {e}")
+    log("LADDER2 COMPLETE")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,12 @@
+#!/bin/bash
+# Background watcher (round 4, pass 3): probe the axon tunnel every
+# ~10 min; on an alive window run tools/tpu_ladder3.py (bench-first).
+# Stops when tools/TPU_LADDER3_DONE or tools/TPU_WATCH_STOP exists.
+cd "$(dirname "$0")/.."
+while true; do
+  [ -f tools/TPU_LADDER3_DONE ] && exit 0
+  [ -f tools/TPU_WATCH_STOP ] && exit 0
+  python tools/tpu_ladder3.py >> tools/tpu_watch.out 2>&1
+  [ -f tools/TPU_LADDER3_DONE ] && exit 0
+  sleep 600
+done
