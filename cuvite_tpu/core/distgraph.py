@@ -101,6 +101,18 @@ class DistGraph:
     def owner_of_padded(self, v: int) -> int:
         return v // self.nv_pad
 
+    def release_slabs(self) -> None:
+        """Drop the O(E) edge-slab arrays, keeping Shard metadata.
+
+        The single-shard bucketed engines consume the slab only during
+        plan construction (the bucket matrices replace it on device), so
+        after PhaseRunner init the slab is dead weight — at benchmark
+        scale, tens of GB of it (tools/scale_model.md).  Callers that
+        still need the edges (sort/fused engines, exchange-plan builds,
+        per-host coarse_edges) simply never call this."""
+        for sh in self.shards:
+            sh.src = sh.dst = sh.w = None
+
     @staticmethod
     def build(
         graph: Graph,
@@ -109,10 +121,25 @@ class DistGraph:
         pad_pow2: bool = True,
         min_nv_pad: int = 1,
         min_ne_pad: int = 1,
+        pad_edges: bool = True,
     ) -> "DistGraph":
         """``min_nv_pad``/``min_ne_pad`` set a floor on the padded shapes so
         successive coarsened phases (whose graphs shrink fast) land on the
-        same compiled executable instead of recompiling per phase."""
+        same compiled executable instead of recompiling per phase.
+
+        ``pad_edges=False`` (single shard only): skip the pow2/floor
+        padding of the edge slab and ALIAS the CSR's tails/weights arrays
+        as the slab's dst/w.  Correct only for consumers that never
+        upload the slab and never rely on tail padding — i.e. the
+        bucketed engines, whose plan builder streams the slab once.  The
+        pow2 slab pad exists for the sort engine's executable reuse and
+        costs up to 2x the real edge bytes (measured 2.06x at R-MAT 24),
+        so the slab-free path is what lets benchmark-scale graphs fit a
+        single host (tools/scale_model.md)."""
+        if not pad_edges and nshards != 1:
+            raise ValueError(
+                "pad_edges=False is the single-shard slab-free layout; "
+                "multi-shard slabs must share padded shapes")
         nv = graph.num_vertices
         parts = balanced_parts(graph, nshards) if balanced else uniform_parts(nv, nshards)
         owned = np.diff(parts)
@@ -134,13 +161,27 @@ class DistGraph:
             for s in range(nshards)
         ]
         ne_pad = max(max(counts) if counts else 1, 1, min_ne_pad)
-        if pad_pow2:
-            ne_pad = next_pow2(ne_pad)
+        if pad_edges:
+            if pad_pow2:
+                ne_pad = next_pow2(ne_pad)
+        elif nshards == 1:
+            ne_pad = max(graph.num_edges, 1)
 
         vdt = graph.policy.vertex_dtype
         wdt = graph.policy.weight_dtype
         shards = []
-        if nshards == 1:
+        if nshards == 1 and not pad_edges and graph.num_edges == ne_pad:
+            # Slab-free layout: dst/w alias the CSR arrays (policy dtypes
+            # already match; astype(copy=False) is a no-op view), only the
+            # expanded src is materialized.  No padding tail exists.
+            n = graph.num_edges
+            shards.append(Shard(
+                base=0, bound=nv,
+                src=np.repeat(np.arange(nv, dtype=vdt), graph.degrees()),
+                dst=graph.tails.astype(vdt, copy=False),
+                w=graph.weights.astype(wdt, copy=False),
+                n_real_edges=n))
+        elif nshards == 1:
             # Single shard: the padded id space IS the original id space
             # (old_to_pad = identity), so the generic path's O(E) int64
             # expand + two fancy-index remaps reduce to plain copies in the

@@ -285,19 +285,23 @@ def _build_native(src, dst, w, nv_local, base, widths):
         dtype=np.int64)
     widths_kept = widths_arr[kept]
     wm_dtype = np.uint8 if unit else w.dtype
+    # O(E) plan arrays are allocated 64-byte aligned so the cpu-backend
+    # upload aliases them instead of duplicating (utils/upload.py).
+    from cuvite_tpu.utils.upload import aligned_full, aligned_zeros
+
     verts_list, dmat_list, wmat_list = [], [], []
     for np_, width in zip(nb_pad, widths_kept):
-        verts_list.append(np.full(np_, nv_local, dtype=np.int64))
-        dmat_list.append(np.zeros((np_, width), dtype=dst.dtype))
-        wmat_list.append(np.zeros((np_, width), dtype=wm_dtype))
+        verts_list.append(aligned_full(np_, nv_local, np.int64))
+        dmat_list.append(aligned_zeros((np_, width), dst.dtype))
+        wmat_list.append(aligned_zeros((np_, width), wm_dtype))
     n_h = int(deg[heavy_mask].sum())
     if n_h:
         heavy_pad = max(int(2 ** np.ceil(np.log2(max(n_h, 1)))), 8)
     else:
         heavy_pad = 8
-    heavy_src = np.full(heavy_pad, nv_local, dtype=src.dtype)
-    heavy_dst = np.zeros(heavy_pad, dtype=dst.dtype)
-    heavy_w = np.zeros(heavy_pad, dtype=w.dtype)
+    heavy_src = aligned_full(heavy_pad, nv_local, src.dtype)
+    heavy_dst = aligned_zeros(heavy_pad, dst.dtype)
+    heavy_w = aligned_zeros(heavy_pad, w.dtype)
     cvn.bucket_fill(dst, w, nv_local, base, row_start,
                     deg.astype(np.int64), cls, widths_kept, nb_pad,
                     verts_list, dmat_list, wmat_list, unit, heavy_pad,

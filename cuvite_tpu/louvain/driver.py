@@ -49,6 +49,7 @@ from cuvite_tpu.louvain.bucketed import (
 )
 from cuvite_tpu.louvain.precise import phase_modularity
 from cuvite_tpu.louvain.step import make_sharded_step, make_single_step
+from cuvite_tpu.utils.upload import aligned_copy, to_device
 
 
 def threshold_for_phase(short_phase: int) -> float:
@@ -356,7 +357,7 @@ class PhaseRunner:
     def __init__(self, dg: DistGraph, mesh=None, engine: str = "sort",
                  budget: int | None = None, exchange: str = "sparse",
                  color_local=None, n_color_classes: int = 0,
-                 ordering: bool = False):
+                 ordering: bool = False, release_slabs: bool = False):
         if engine not in ("sort", "bucketed", "pallas"):
             raise ValueError(f"unknown engine {engine!r}; use 'sort', "
                              "'bucketed' or 'pallas' ('auto' is resolved "
@@ -576,18 +577,18 @@ class PhaseRunner:
                     dmat[:nb] = b.dst
                     wmat[:nb] = b.w
                     buckets.append((
-                        jnp.asarray(verts.astype(vdt)),
-                        jnp.asarray(np.ascontiguousarray(
-                            dmat.T.astype(vdt))),
-                        jnp.asarray(np.ascontiguousarray(
-                            wmat.T.astype(wdt))),
+                        to_device(verts, vdt),
+                        to_device(aligned_copy(
+                            dmat.T.astype(vdt, copy=False))),
+                        to_device(aligned_copy(
+                            wmat.T.astype(wdt, copy=False))),
                     ))
                     flags.append(True)
                     verts_np.append(verts)
                 else:
-                    buckets.append((jnp.asarray(b.verts.astype(vdt)),
-                                    jnp.asarray(b.dst.astype(vdt)),
-                                    jnp.asarray(
+                    buckets.append((to_device(b.verts, vdt),
+                                    to_device(b.dst, vdt),
+                                    to_device(
                                         compress_unit_weights(b.w, wdt))))
                     flags.append(False)
                     verts_np.append(b.verts)
@@ -609,11 +610,11 @@ class PhaseRunner:
                         f"{PALLAS_MAX_WIDTH}); the rest run the XLA paths",
                         stacklevel=2)
             interp = jax.default_backend() != "tpu"
-            heavy = (jnp.asarray(plan.heavy_src.astype(vdt)),
-                     jnp.asarray(plan.heavy_dst.astype(vdt)),
-                     jnp.asarray(plan.heavy_w.astype(wdt)))
-            self_loop = jnp.asarray(plan.self_loop.astype(wdt))
-            perm_dev = jnp.asarray(
+            heavy = (to_device(plan.heavy_src, vdt),
+                     to_device(plan.heavy_dst, vdt),
+                     to_device(plan.heavy_w, wdt))
+            self_loop = to_device(plan.self_loop, wdt)
+            perm_dev = to_device(
                 build_assemble_perm(verts_np, dg.nv_pad))
             adt_np = adt
 
@@ -650,20 +651,20 @@ class PhaseRunner:
                                      dg.nv_pad).astype(src_np.dtype)
                     pc = BucketPlan.build(src_c, dst_np, w_np,
                                           nv_local=dg.nv_pad, base=0)
-                    bk = tuple((jnp.asarray(b.verts.astype(vdt)),
-                                jnp.asarray(b.dst.astype(vdt)),
-                                jnp.asarray(b.w.astype(wdt)))
+                    bk = tuple((to_device(b.verts, vdt),
+                                to_device(b.dst, vdt),
+                                to_device(b.w, wdt))
                                for b in pc.buckets)
-                    hv = (jnp.asarray(pc.heavy_src.astype(vdt)),
-                          jnp.asarray(pc.heavy_dst.astype(vdt)),
-                          jnp.asarray(pc.heavy_w.astype(wdt)))
+                    hv = (to_device(pc.heavy_src, vdt),
+                          to_device(pc.heavy_dst, vdt),
+                          to_device(pc.heavy_w, wdt))
                     self._class_plans.append(
-                        (bk, hv, jnp.asarray(pc.self_loop.astype(wdt))))
+                        (bk, hv, to_device(pc.self_loop, wdt)))
                 # non-pallas full plan for the per-iteration modularity pass
                 mod_buckets = tuple(
-                    (jnp.asarray(b.verts.astype(vdt)),
-                     jnp.asarray(b.dst.astype(vdt)),
-                     jnp.asarray(b.w.astype(wdt)))
+                    (to_device(b.verts, vdt),
+                     to_device(b.dst, vdt),
+                     to_device(b.w, wdt))
                     for b in plan.buckets
                 ) if use_pallas else buckets
                 self._mod_args = (mod_buckets, heavy, self_loop)
@@ -690,12 +691,12 @@ class PhaseRunner:
             assert dg.nshards == 1
             if slab_engine:
                 src, dst, w = dg.stacked_edges()
-                self.src = jnp.asarray(src.astype(vdt))
-                self.dst = jnp.asarray(dst.astype(vdt))
-                self.w = jnp.asarray(w.astype(wdt))
-            self.vdeg = jnp.asarray(vdeg)
-            self.comm0 = jnp.asarray(comm0)
-            self.real_mask_dev = jnp.asarray(self.real_mask)
+                self.src = to_device(src, vdt)
+                self.dst = to_device(dst, vdt)
+                self.w = to_device(w, wdt)
+            self.vdeg = to_device(vdeg)
+            self.comm0 = to_device(comm0)
+            self.real_mask_dev = to_device(self.real_mask)
         tw = dg.graph.total_edge_weight_twice()
         if multi:
             # Replicated GLOBAL scalar: a committed single-device array would
@@ -711,6 +712,11 @@ class PhaseRunner:
         else:
             self._extra = (self.src, self.dst, self.w, self.vdeg,
                            self.constant)
+        if release_slabs and self._bucket_extra is not None \
+                and dg.nshards == 1:
+            # Bucket matrices replaced the slab; at benchmark scale the
+            # host slab is tens of GB of dead weight from here on.
+            dg.release_slabs()
 
     def run(
         self,
@@ -1266,11 +1272,20 @@ def louvain_phases(
         g_ne = g.num_edges
         # Shape floors: every coarsened phase small enough to fit them reuses
         # one compiled step instead of recompiling per phase.
+        # Single-shard bucketed engines never upload the edge slab: skip
+        # its pow2 padding, alias the CSR as the slab, and release it after
+        # plan construction — the footprint work that fits benchmark-scale
+        # graphs on one host (tools/scale_model.md).
+        slabless = (engine in ("bucketed", "pallas") and nshards == 1
+                    and not g_is_dv
+                    and (mesh is None
+                         or int(np.prod(mesh.devices.shape)) == 1))
         with tracer.stage("plan"):
             dg = g if g_is_dv else DistGraph.build(
                 g, nshards, balanced=balanced,
                 min_nv_pad=max(1, 4096 // nshards),
                 min_ne_pad=max(1, 16384 // nshards),
+                pad_edges=not slabless,
             )
         if exchange == "auto":
             # Per PHASE: coarse phases of a huge graph shrink back under
@@ -1362,6 +1377,7 @@ def louvain_phases(
                             color_local=color_np,
                             n_color_classes=n_classes,
                             ordering=bool(vertex_ordering and not coloring),
+                            release_slabs=slabless,
                         )
                 with tracer.stage("iterate"):
                     cp, cm, it, ovf = runner.run(run_threshold, **run_kw)
@@ -1435,6 +1451,14 @@ def louvain_phases(
             if one_phase:
                 prev_mod = curr_mod
                 break
+            if slabless:
+                # Device plans + old phase state die before the coarsen
+                # transient peaks (the runner holds the only refs to the
+                # uploaded bucket matrices; dg holds the released slabs +
+                # the remap tables).  comm_pad/dense survive via comm_old.
+                runner = None
+                comm_pad = None
+                dg = None
             with tracer.stage("coarsen"):
                 if g_is_dv:
                     # send_newEdges analog: local coarse triples,

@@ -94,7 +94,8 @@ def _bind(lib: ctypes.CDLL) -> None:
                                    vp, vp, vp]
     lib.cv_coarsen.restype = i64
     lib.cv_coarsen.argtypes = [i64, i64, p_i64, vp, vp, ctypes.c_int,
-                               ctypes.c_int, p_i32, p_i64, p_i32, p_f32]
+                               ctypes.c_int, p_i32, p_i64, p_i32, p_f32,
+                               ctypes.c_int]
     lib.cv_weighted_degrees.restype = None
     lib.cv_weighted_degrees.argtypes = [i64, p_i64, vp, ctypes.c_int, p_f64]
 
@@ -240,12 +241,30 @@ def _vp(a: np.ndarray):
     return ctypes.c_void_p(a.ctypes.data)
 
 
+def _mem_available_bytes():
+    """Linux MemAvailable (None elsewhere): sizes the coarsen path choice."""
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
 def coarsen_csr(offsets: np.ndarray, tails: np.ndarray, weights: np.ndarray,
                 labels: np.ndarray, nc: int):
     """Fused relabel + coalesce of a CSR graph into its community graph
     (see cv_coarsen).  Returns (offsets[i64], tails[i32], weights[f32]);
     requires nc <= 2^31.  Bit-identical to relabel + Graph.from_edges
-    (symmetrize=False, f32 weight policy)."""
+    (symmetrize=False, f32 weight policy).
+
+    Path choice for nc > 2^22 (below that the dense path always wins):
+    the LSD radix's ping-pong transient is 32 B/slot; when that exceeds
+    half of MemAvailable, the 12 B/slot counting+dense path is forced so
+    benchmark-scale phase-0 coarsens cannot OOM (both paths are
+    bit-identical; CUVITE_COARSEN_FORCE=dense|radix overrides)."""
     lib = _load()
     assert lib is not None
     offsets = np.ascontiguousarray(offsets, dtype=np.int64)
@@ -255,6 +274,15 @@ def coarsen_csr(offsets: np.ndarray, tails: np.ndarray, weights: np.ndarray,
     if weights.dtype not in (np.float32, np.float64):
         weights = weights.astype(np.float32)
     labels = np.ascontiguousarray(labels, dtype=np.int32)
+    force_dense = 0
+    if nc > (1 << 22):
+        knob = os.environ.get("CUVITE_COARSEN_FORCE", "")
+        if knob == "dense":
+            force_dense = 1
+        elif knob != "radix":
+            avail = _mem_available_bytes()
+            if avail is not None and 32 * len(tails) > avail // 2:
+                force_dense = 1
     cap = max(len(tails), 1)
     offsets_out = np.empty(nc + 1, dtype=np.int64)
     tails_out = np.empty(cap, dtype=np.int32)
@@ -262,7 +290,7 @@ def coarsen_csr(offsets: np.ndarray, tails: np.ndarray, weights: np.ndarray,
     n = lib.cv_coarsen(len(offsets) - 1, nc, offsets, _vp(tails),
                        _vp(weights), int(tails.dtype == np.int64),
                        int(weights.dtype == np.float64), labels,
-                       offsets_out, tails_out, wout)
+                       offsets_out, tails_out, wout, force_dense)
     if n < 0:
         raise ValueError("cv_coarsen: label out of range or nc > 2^31")
     return offsets_out, tails_out[:n].copy(), wout[:n].copy()

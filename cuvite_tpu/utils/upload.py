@@ -1,0 +1,77 @@
+"""Copy-free device upload for phase-static host arrays.
+
+The bucketed engine uploads O(E) plan matrices once per phase.  On a real
+TPU that is an unavoidable host->device DMA, but on the cpu backend (the
+virtual-mesh test rig and the single-host benchmark fallback) a plain
+``jnp.asarray(x.astype(dt))`` costs up to TWO extra copies of an
+already-multi-GB array: ``astype`` copies even when the dtype matches,
+and the cpu "device" buffer is a second host allocation.  At benchmark
+scale (R-MAT 26: ~14 GB of plan matrices) that duplication is the
+difference between fitting this host and OOM (tools/scale_model.md).
+
+``to_device`` removes both: ``astype(copy=False)`` and, on the cpu
+backend, a DLPack import (``jnp.from_dlpack``) that ALIASES the numpy
+buffer — zero bytes moved.  XLA:CPU only aliases an imported buffer that
+is 64-byte aligned (measured under jax 0.9: unaligned imports silently
+copy), and numpy's own allocator gives no such guarantee, so the plan
+builders allocate their O(E) arrays with ``aligned_empty``/friends below
+and ``to_device`` attempts the import only when the pointer is aligned
+(an unaligned source would just pay the same one copy as ``asarray``).
+
+Contract for zero-copy sources: the caller must treat the numpy array as
+frozen afterwards (the jax array reads the same memory; XLA never writes
+to non-donated inputs, and none of these uploads are donated).  All call
+sites pass freshly built, write-once plan/slab arrays.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ALIGN = 64  # XLA:CPU zero-copy import requires 64-byte aligned buffers
+
+
+def aligned_empty(shape, dtype) -> np.ndarray:
+    """np.empty whose data pointer is ALIGN-byte aligned (see module doc)."""
+    shape = (shape,) if np.isscalar(shape) else tuple(shape)
+    dt = np.dtype(dtype)
+    nbytes = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+    buf = np.empty(nbytes + ALIGN, dtype=np.uint8)
+    off = (-buf.ctypes.data) % ALIGN
+    return buf[off:off + nbytes].view(dt).reshape(shape)
+
+
+def aligned_zeros(shape, dtype) -> np.ndarray:
+    out = aligned_empty(shape, dtype)
+    out[...] = 0
+    return out
+
+
+def aligned_full(shape, fill, dtype) -> np.ndarray:
+    out = aligned_empty(shape, dtype)
+    out[...] = fill
+    return out
+
+
+def aligned_copy(a: np.ndarray) -> np.ndarray:
+    """C-contiguous ALIGN-aligned copy (use instead of ascontiguousarray
+    when the result will be uploaded with ``to_device``)."""
+    out = aligned_empty(a.shape, a.dtype)
+    np.copyto(out, a)
+    return out
+
+
+def to_device(x, dtype=None):
+    """jnp.asarray with the copies removed where legal (see module doc)."""
+    x = np.asarray(x)
+    if dtype is not None:
+        x = x.astype(dtype, copy=False)
+    if (jax.default_backend() == "cpu" and x.size
+            and x.flags.c_contiguous and x.ctypes.data % ALIGN == 0):
+        try:
+            return jnp.from_dlpack(x)
+        except Exception:
+            pass  # exotic dtype: fall through to the copy path
+    return jnp.asarray(x)

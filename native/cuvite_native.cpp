@@ -314,17 +314,22 @@ template <typename IdT, typename WT>
 static int64_t coarsen_impl(int64_t nv, int64_t nc, const int64_t* offsets,
                             const IdT* tails, const WT* w,
                             const int32_t* labels, int64_t* offsets_out,
-                            int32_t* tails_out, float* weights_out) {
+                            int32_t* tails_out, float* weights_out,
+                            int force_dense) {
   if (nc < 0 || nc > ((int64_t)1 << 31)) return -1;
   const int64_t m = offsets[nv];
   for (int64_t v = 0; v < nv; ++v)
     if (labels[v] < 0 || labels[v] >= nc) return -1;
 
-  // Small-nc fast path: counting-sort rows by coarse src, then dense
-  // per-row accumulation (generation-stamped scratch).  Same output as
-  // the sort path: duplicates accumulate in CSR order, unique tails
-  // emitted ascending.
-  if (nc <= ((int64_t)1 << 22)) {
+  // Counting-sort path: rows by coarse src, then dense per-row
+  // accumulation (generation-stamped scratch).  Same output as the sort
+  // path: duplicates accumulate in CSR order, unique tails emitted
+  // ascending.  Default for small nc (the O(nc) scratch is hot); also
+  // selected by the caller via ``force_dense`` for benchmark-scale
+  // graphs where the radix path's 32 B/slot ping-pong transient exceeds
+  // host RAM — this path peaks at 12 B/slot + O(nc)
+  // (tools/scale_model.md).
+  if (force_dense || nc <= ((int64_t)1 << 22)) {
     std::vector<int64_t> row_start(nc + 1, 0);
     for (int64_t v = 0; v < nv; ++v)
       row_start[(int64_t)labels[v] + 1] += offsets[v + 1] - offsets[v];
@@ -461,23 +466,23 @@ extern "C" int64_t cv_coarsen(int64_t nv, int64_t nc, const int64_t* offsets,
                               const void* tails, const void* w, int id64,
                               int w64, const int32_t* labels,
                               int64_t* offsets_out, int32_t* tails_out,
-                              float* weights_out) {
+                              float* weights_out, int force_dense) {
   if (id64) {
     if (w64)
       return coarsen_impl(nv, nc, offsets, (const int64_t*)tails,
                           (const double*)w, labels, offsets_out, tails_out,
-                          weights_out);
+                          weights_out, force_dense);
     return coarsen_impl(nv, nc, offsets, (const int64_t*)tails,
                         (const float*)w, labels, offsets_out, tails_out,
-                        weights_out);
+                        weights_out, force_dense);
   }
   if (w64)
     return coarsen_impl(nv, nc, offsets, (const int32_t*)tails,
                         (const double*)w, labels, offsets_out, tails_out,
-                        weights_out);
+                        weights_out, force_dense);
   return coarsen_impl(nv, nc, offsets, (const int32_t*)tails,
                       (const float*)w, labels, offsets_out, tails_out,
-                      weights_out);
+                      weights_out, force_dense);
 }
 
 // Per-vertex weighted degree straight off the CSR: one sequential f64

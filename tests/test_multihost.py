@@ -74,6 +74,46 @@ print(f"proc {proc}: OK Q={res.modularity:.6f}")
 """
 
 
+DV4_WORKER = r"""
+import os, sys
+proc = int(sys.argv[1]); n = int(sys.argv[2]); port = sys.argv[3]
+out_dir = sys.argv[4]
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+from cuvite_tpu.utils.compile_cache import enable_compile_cache
+enable_compile_cache()  # 4 processes share one content-addressed cache
+from cuvite_tpu.comm.multihost import initialize
+initialize(coordinator=f"127.0.0.1:{port}", num_processes=n, process_id=proc)
+
+import numpy as np
+from cuvite_tpu.io.dist_ingest import DistVite
+from cuvite_tpu.louvain.driver import louvain_phases
+
+nsh = 2 * n
+dv = DistVite.load(os.path.join(out_dir, "g.bin"), nsh)
+# Per-process ghost-count sanity at a scale where routing is non-trivial:
+# every local shard must reference ghosts (rmat-15 is far from block
+# diagonal), and remote shards must hold no edge arrays at all.
+ghost_counts = {}
+for s in range(dv.local_lo, dv.local_hi):
+    sh = dv.shards[s]
+    real = np.asarray(sh.src) < dv.nv_pad
+    d = np.asarray(sh.dst)[real].astype(np.int64)
+    owned = (d >= s * dv.nv_pad) & (d < (s + 1) * dv.nv_pad)
+    ghost_counts[s] = int(len(np.unique(d[~owned])))
+    assert 0 < ghost_counts[s] < dv.total_padded_vertices, ghost_counts
+remote = [s for s in range(nsh) if not (dv.local_lo <= s < dv.local_hi)]
+assert remote and all(dv.shards[s].src is None for s in remote)
+
+res = louvain_phases(dv)
+np.save(os.path.join(out_dir, f"dv4comm.{proc}.npy"), res.communities)
+with open(os.path.join(out_dir, f"dv4info.{proc}"), "w") as f:
+    f.write(repr((float(res.modularity), ghost_counts)))
+print(f"proc {proc}: OK Q={res.modularity:.6f} ghosts={ghost_counts}")
+"""
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -119,6 +159,51 @@ def test_two_process_run_matches_single(tmp_path):
         "2-process run differs from single-process 8-shard run"
     q0 = float(open(tmp_path / "mod.0").read())
     assert abs(q0 - ref.modularity) < 1e-6
+
+
+def test_four_process_dist_ingest_rmat15(tmp_path):
+    """4 processes x 2 virtual devices, per-host sharded ingest of R-MAT 15
+    (~1M directed edges): ghost routing is non-trivial on every shard
+    (asserted per process), each process range-reads only its 2 shards,
+    and the 8-shard distributed clustering is bit-identical to the
+    single-process full-ingest run — the reference's oversubscribed-ranks
+    practice at benchmark-family scale (/root/reference/README:48-53)."""
+    from cuvite_tpu.io.generate import generate_rmat
+    from cuvite_tpu.io.vite import write_vite
+    from cuvite_tpu.louvain.driver import louvain_phases
+
+    g = generate_rmat(15, edge_factor=16, seed=1)
+    write_vite(str(tmp_path / "g.bin"), g)
+    (tmp_path / "worker.py").write_text(DV4_WORKER)
+    port = _free_port()
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)
+    nproc = 4
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(tmp_path / "worker.py"), str(i),
+             str(nproc), str(port), str(tmp_path)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(nproc)
+    ]
+    outs = [p.communicate(timeout=840)[0] for p in procs]
+    for p, o in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{o[-3000:]}"
+
+    comms = [np.load(tmp_path / f"dv4comm.{i}.npy") for i in range(nproc)]
+    for c in comms[1:]:
+        assert np.array_equal(comms[0], c), "processes disagree"
+    infos = [eval(open(tmp_path / f"dv4info.{i}").read())
+             for i in range(nproc)]
+    shards_seen = sorted(s for _, gc in infos for s in gc)
+    assert shards_seen == list(range(8)), shards_seen
+
+    ref = louvain_phases(g, nshards=8)
+    assert np.array_equal(comms[0], ref.communities), \
+        "4-process dist-ingest differs from single-process full ingest"
+    assert abs(infos[0][0] - ref.modularity) < 1e-6
 
 
 def test_two_process_dist_ingest(tmp_path):
