@@ -114,6 +114,29 @@ class Graph:
                 weights=wcnt.astype(policy.weight_dtype, copy=False),
                 policy=policy,
             )
+        # Weighted low-footprint path (benchmark-scale weighted ingest,
+        # VERDICT r3 item 8): the sort carries an int32 original-edge
+        # index, never the f64 weights, and emits int32/f32 directly —
+        # ~24 B/slot transient vs the generic path's 32, with int64
+        # src/dst accepted as-is (no width conversion).  Output is
+        # bit-identical to the generic path + policy cast (accumulation
+        # order preserved by sort stability).  Small nv keeps the generic
+        # route, whose dense counting path wins there.
+        if (weights is not None and len(src) >= native.MIN_NATIVE_EDGES
+                and native.available()
+                and (1 << 22) < num_vertices <= (1 << 31)
+                and policy.weight_dtype == np.float32
+                and (2 * len(src) if symmetrize else len(src))
+                < (1 << 31)):
+            offsets, tails, w32 = native.build_csr_w(
+                num_vertices, src, dst, weights, symmetrize
+            )
+            return Graph(
+                offsets=offsets,
+                tails=tails.astype(policy.vertex_dtype, copy=False),
+                weights=w32,
+                policy=policy,
+            )
         src = np.asarray(src, dtype=np.int64)
         dst = np.asarray(dst, dtype=np.int64)
         # Accumulate duplicate-edge sums from the raw f64 weights; the cast

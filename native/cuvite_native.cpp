@@ -64,6 +64,79 @@
 // (~16 B/slot for UNIT): the expanded edge list is freed/moved before
 // the ping-pong buffers are allocated.
 
+struct NoPayload {};
+
+// Byte-wise LSD radix on uint64 keys with an optional ping-pong payload
+// (P = NoPayload sorts keys alone).  Stable, so duplicates keep input
+// order.  8-bit digits (16-bit digits measured ~2x slower on this host:
+// 64 K per-bucket write streams thrash L1/TLB; 256 stay cache-resident).
+// The histogram/scatter loops run over BLOCK ids, not thread ids, so
+// correctness holds for any actual OpenMP team size (OMP_DYNAMIC,
+// thread limits, nested regions) — every block is processed exactly
+// once, whoever runs it.  The exclusive scan is digit-major then
+// block-minor: block t's digit-b slots start after every block's
+// smaller digits and after earlier blocks' digit-b entries — preserving
+// LSD stability.  Shared by the three O(E) sorts (both CSR builders'
+// radix branches and the large-nc coarsen); transient = one key + one
+// payload ping-pong buffer, allocated here.
+template <typename P>
+static void radix_sort_pairs(std::vector<uint64_t>& key, std::vector<P>& pay,
+                             int key_bits) {
+  constexpr bool HAS_P = !std::is_same<P, NoPayload>::value;
+  const int64_t m = (int64_t)key.size();
+  std::vector<uint64_t> key2(m);
+  std::vector<P> pay2;
+  if constexpr (HAS_P) pay2.resize(m);
+#if defined(_OPENMP)
+  const int nt = omp_get_max_threads();
+#else
+  const int nt = 1;
+#endif
+  constexpr int DIGIT_BITS = 8;
+  constexpr int NB = 1 << DIGIT_BITS;
+  constexpr uint64_t DMASK = NB - 1;
+  std::vector<int64_t> hist((size_t)nt * NB);
+  const int64_t blk = (m + nt - 1) / (nt > 0 ? nt : 1);
+  for (int shift = 0; shift < key_bits; shift += DIGIT_BITS) {
+    std::fill(hist.begin(), hist.end(), 0);
+#pragma omp parallel for schedule(static)
+    for (int t = 0; t < nt; ++t) {
+      int64_t* h = hist.data() + (size_t)t * NB;
+      const int64_t lo = t * blk, hi = std::min<int64_t>(m, lo + blk);
+      for (int64_t j = lo; j < hi; ++j) h[(key[j] >> shift) & DMASK]++;
+    }
+    int64_t run = 0;
+    for (int b = 0; b < NB; ++b) {
+      for (int t = 0; t < nt; ++t) {
+        int64_t c = hist[(size_t)t * NB + b];
+        hist[(size_t)t * NB + b] = run;
+        run += c;
+      }
+    }
+#pragma omp parallel for schedule(static)
+    for (int t = 0; t < nt; ++t) {
+      int64_t* h = hist.data() + (size_t)t * NB;
+      const int64_t lo = t * blk, hi = std::min<int64_t>(m, lo + blk);
+      for (int64_t j = lo; j < hi; ++j) {
+        int64_t slot = h[(key[j] >> shift) & DMASK]++;
+        key2[slot] = key[j];
+        if constexpr (HAS_P) pay2[slot] = pay[j];
+      }
+    }
+    key.swap(key2);
+    if constexpr (HAS_P) pay.swap(pay2);
+  }
+}
+
+// Key width for a composite key a*nv + b, a,b < nv: max key is
+// nv*nv - 1 < 2^(2*ceil(log2 nv)); computing from bits(nv-1) avoids
+// evaluating nv*nv, which wraps at nv == 2^32.
+static int composite_key_bits(uint64_t nv) {
+  int vb = 0;
+  for (uint64_t x = nv > 0 ? nv - 1 : 0; x; x >>= 1) ++vb;
+  return 2 * vb;
+}
+
 template <typename IdT, bool UNIT>
 static int64_t build_csr_impl(
     int64_t nv, int64_t ne, const IdT* src, const IdT* dst, const double* w,
@@ -164,8 +237,7 @@ static int64_t build_csr_impl(
     return n_out;
   }
 
-  // Byte-wise LSD radix on the composite key (digit-width A/B rationale in
-  // the header comment).  Stable, so duplicate edges stay in input order.
+  // Byte-wise LSD radix on the composite key (radix_sort_pairs).
   const uint64_t unv = (uint64_t)nv;
   std::vector<uint64_t> key(m);
   for (int64_t j = 0; j < m; ++j)
@@ -173,63 +245,11 @@ static int64_t build_csr_impl(
   xs.clear(); xs.shrink_to_fit();
   xd.clear(); xd.shrink_to_fit();
   std::vector<double> pw(std::move(xw));
-  std::vector<uint64_t> key2(m);
-  std::vector<double> pw2;
-  if (!UNIT) pw2.resize(m);
-  // Max key is nv*nv-1 < 2^(2*ceil(log2 nv)); computing the bound from
-  // bits(nv-1) avoids evaluating unv*unv, which wraps at nv == 2^32.
-  int key_bits = 0;
-  {
-    int vb = 0;
-    for (uint64_t x = unv > 0 ? unv - 1 : 0; x; x >>= 1) ++vb;
-    key_bits = 2 * vb;
-  }
-  {
-#if defined(_OPENMP)
-    const int nt = omp_get_max_threads();
-#else
-    const int nt = 1;
-#endif
-    constexpr int DIGIT_BITS = 8;  // see the A/B note in the header
-    constexpr int NB = 1 << DIGIT_BITS;
-    constexpr uint64_t DMASK = NB - 1;
-    std::vector<int64_t> hist((size_t)nt * NB);
-    const int64_t blk = (m + nt - 1) / (nt > 0 ? nt : 1);
-    for (int shift = 0; shift < key_bits; shift += DIGIT_BITS) {
-      std::fill(hist.begin(), hist.end(), 0);
-      // Loop over BLOCK ids (not thread ids): correctness holds for any
-      // actual team size (OMP_DYNAMIC, thread limits, nested regions) —
-      // every block is processed exactly once, whoever runs it.
-#pragma omp parallel for schedule(static)
-      for (int t = 0; t < nt; ++t) {
-        int64_t* h = hist.data() + (size_t)t * NB;
-        const int64_t lo = t * blk, hi = std::min<int64_t>(m, lo + blk);
-        for (int64_t j = lo; j < hi; ++j) h[(key[j] >> shift) & DMASK]++;
-      }
-      // Exclusive scan, digit-major then block-minor: block t's digit-b
-      // slots start after every block's smaller digits and after earlier
-      // blocks' digit-b entries — preserving LSD stability.
-      int64_t run = 0;
-      for (int b = 0; b < NB; ++b) {
-        for (int t = 0; t < nt; ++t) {
-          int64_t c = hist[(size_t)t * NB + b];
-          hist[(size_t)t * NB + b] = run;
-          run += c;
-        }
-      }
-#pragma omp parallel for schedule(static)
-      for (int t = 0; t < nt; ++t) {
-        int64_t* h = hist.data() + (size_t)t * NB;
-        const int64_t lo = t * blk, hi = std::min<int64_t>(m, lo + blk);
-        for (int64_t j = lo; j < hi; ++j) {
-          int64_t slot = h[(key[j] >> shift) & DMASK]++;
-          key2[slot] = key[j];
-          if constexpr (!UNIT) pw2[slot] = pw[j];
-        }
-      }
-      key.swap(key2);
-      if constexpr (!UNIT) pw.swap(pw2);
-    }
+  if constexpr (UNIT) {
+    std::vector<NoPayload> none;
+    radix_sort_pairs(key, none, composite_key_bits(unv));
+  } else {
+    radix_sort_pairs(key, pw, composite_key_bits(unv));
   }
 
   // Linear coalesce of the sorted stream into the CSR.
@@ -268,6 +288,83 @@ static int64_t build_csr_impl(
   return n_out;
 }
 
+// Weighted low-footprint CSR builder (int32 ids, f32 output weights).
+//
+// The generic cv_build_csr carries an f64 payload through every radix
+// pass (key+payload ping-pong = 32 B/slot) and emits int64/f64 outputs —
+// ~65 B/slot end to end, which OOM-killed a weighted scale-26 ingest at
+// 131 GB (tools/scale_model.md).  This variant sorts an int32 ORIGINAL-
+// EDGE-INDEX payload instead (key 8x2 + idx 4x2 = 24 B/slot transient)
+// and gathers w[idx] only at the linear coalesce, accumulating in double
+// and casting to f32 once per unique edge — the exact value the generic
+// path produces after its policy cast, because a stable sort of indices
+// visits duplicates in the same input order the f64-payload sort does.
+// Requires nv <= 2^31 and expanded edge count < 2^31 (int32 index).
+template <typename IdT>
+static int64_t build_csr_w32_impl(int64_t nv, int64_t ne, const IdT* src,
+                                  const IdT* dst, const double* w,
+                                  int symmetrize, int64_t* offsets_out,
+                                  int32_t* tails_out, float* weights_out) {
+  if (nv < 0 || nv > ((int64_t)1 << 31)) return -1;
+  for (int64_t j = 0; j < ne; ++j) {
+    if (src[j] < 0 || src[j] >= nv || dst[j] < 0 || dst[j] >= nv) return -1;
+  }
+  int64_t m = ne;
+  int64_t nself = 0;
+  if (symmetrize) {
+    for (int64_t j = 0; j < ne; ++j) nself += (src[j] == dst[j]);
+    m = 2 * ne - nself;
+  }
+  if (m >= ((int64_t)1 << 31)) return -1;  // int32 index payload bound
+  const uint64_t unv = (uint64_t)nv;
+
+  // Expanded key + original-edge-index payload.  Mirrored entries point
+  // at the ORIGINAL edge's weight; expansion order (originals first,
+  // mirrors after) matches the numpy concatenation, so stable sorting
+  // reproduces the generic accumulation order exactly.
+  std::vector<uint64_t> key(m);
+  std::vector<int32_t> idx(m);
+  for (int64_t j = 0; j < ne; ++j) {
+    key[j] = (uint64_t)src[j] * unv + (uint64_t)dst[j];
+    idx[j] = (int32_t)j;
+  }
+  if (symmetrize) {
+    int64_t k = ne;
+    for (int64_t j = 0; j < ne; ++j) {
+      if (src[j] != dst[j]) {
+        key[k] = (uint64_t)dst[j] * unv + (uint64_t)src[j];
+        idx[k] = (int32_t)j;
+        ++k;
+      }
+    }
+  }
+
+  // Byte-wise LSD radix (radix_sort_pairs), payload = int32 index.
+  radix_sort_pairs(key, idx, composite_key_bits(unv));
+
+  // Linear coalesce: gather w[idx] in sorted order, accumulate in double
+  // per run, cast once at emission.
+  std::memset(offsets_out, 0, (nv + 1) * sizeof(int64_t));
+  int64_t n_out = 0;
+  uint64_t prev_key = ~0ull;
+  double acc = 0.0;
+  for (int64_t j = 0; j < m; ++j) {
+    if (key[j] == prev_key) {
+      acc += w[idx[j]];
+    } else {
+      if (n_out) weights_out[n_out - 1] = (float)acc;
+      prev_key = key[j];
+      acc = w[idx[j]];
+      tails_out[n_out] = (int32_t)(key[j] % unv);
+      offsets_out[key[j] / unv + 1]++;
+      ++n_out;
+    }
+  }
+  if (n_out) weights_out[n_out - 1] = (float)acc;
+  for (int64_t v = 0; v < nv; ++v) offsets_out[v + 1] += offsets_out[v];
+  return n_out;
+}
+
 extern "C" {
 
 // offsets_out must hold nv+1 entries; tails_out/weights_out must hold
@@ -289,6 +386,21 @@ int64_t cv_build_csr_unit(int64_t nv, int64_t ne, const int32_t* src,
                           float* weights_out) {
   return build_csr_impl<int32_t, true>(nv, ne, src, dst, nullptr, symmetrize,
                                        offsets_out, tails_out, weights_out);
+}
+
+// Weighted low-footprint builder (see build_csr_w32_impl); src/dst may be
+// int32 or int64 (id64 flag) — no width conversion is ever materialized.
+int64_t cv_build_csr_w32(int64_t nv, int64_t ne, const void* src,
+                         const void* dst, const double* w, int id64,
+                         int symmetrize, int64_t* offsets_out,
+                         int32_t* tails_out, float* weights_out) {
+  if (id64)
+    return build_csr_w32_impl(nv, ne, (const int64_t*)src,
+                              (const int64_t*)dst, w, symmetrize,
+                              offsets_out, tails_out, weights_out);
+  return build_csr_w32_impl(nv, ne, (const int32_t*)src,
+                            (const int32_t*)dst, w, symmetrize,
+                            offsets_out, tails_out, weights_out);
 }
 
 // ---------------------------------------------------------------------------
@@ -378,8 +490,8 @@ static int64_t coarsen_impl(int64_t nv, int64_t nc, const int64_t* offsets,
     return n_out;
   }
 
-  // Large-nc: byte-wise LSD radix on labels[s]*nc + labels[d] (same digit
-  // scheme + stability argument as build_csr_impl).
+  // Large-nc: byte-wise LSD radix on labels[s]*nc + labels[d]
+  // (radix_sort_pairs — same stability argument as build_csr_impl).
   const uint64_t unc = (uint64_t)nc;
   std::vector<uint64_t> key(m);
   std::vector<double> pw(m);
@@ -390,55 +502,7 @@ static int64_t coarsen_impl(int64_t nv, int64_t nc, const int64_t* offsets,
       pw[k] = (double)w[k];
     }
   }
-  std::vector<uint64_t> key2(m);
-  std::vector<double> pw2(m);
-  int key_bits = 0;
-  {
-    int vb = 0;
-    for (uint64_t x = unc > 0 ? unc - 1 : 0; x; x >>= 1) ++vb;
-    key_bits = 2 * vb;
-  }
-  {
-#if defined(_OPENMP)
-    const int nt = omp_get_max_threads();
-#else
-    const int nt = 1;
-#endif
-    constexpr int DIGIT_BITS = 8;
-    constexpr int NB = 1 << DIGIT_BITS;
-    constexpr uint64_t DMASK = NB - 1;
-    std::vector<int64_t> hist((size_t)nt * NB);
-    const int64_t blk = (m + nt - 1) / (nt > 0 ? nt : 1);
-    for (int shift = 0; shift < key_bits; shift += DIGIT_BITS) {
-      std::fill(hist.begin(), hist.end(), 0);
-#pragma omp parallel for schedule(static)
-      for (int t = 0; t < nt; ++t) {
-        int64_t* h = hist.data() + (size_t)t * NB;
-        const int64_t lo = t * blk, hi = std::min<int64_t>(m, lo + blk);
-        for (int64_t j = lo; j < hi; ++j) h[(key[j] >> shift) & DMASK]++;
-      }
-      int64_t run = 0;
-      for (int b = 0; b < NB; ++b) {
-        for (int t = 0; t < nt; ++t) {
-          int64_t c = hist[(size_t)t * NB + b];
-          hist[(size_t)t * NB + b] = run;
-          run += c;
-        }
-      }
-#pragma omp parallel for schedule(static)
-      for (int t = 0; t < nt; ++t) {
-        int64_t* h = hist.data() + (size_t)t * NB;
-        const int64_t lo = t * blk, hi = std::min<int64_t>(m, lo + blk);
-        for (int64_t j = lo; j < hi; ++j) {
-          int64_t slot = h[(key[j] >> shift) & DMASK]++;
-          key2[slot] = key[j];
-          pw2[slot] = pw[j];
-        }
-      }
-      key.swap(key2);
-      pw.swap(pw2);
-    }
-  }
+  radix_sort_pairs(key, pw, composite_key_bits(unc));
   std::memset(offsets_out, 0, (nc + 1) * sizeof(int64_t));
   int64_t n_out = 0;
   uint64_t prev_key = ~0ull;

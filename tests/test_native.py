@@ -505,3 +505,51 @@ def test_distgraph_single_shard_fast_path():
     assert np.all(sh.src[n:] == dg.nv_pad)
     assert np.all(sh.w[n:] == 0)
     assert np.array_equal(dg.old_to_pad, np.arange(nv))
+
+
+@pytest.mark.parametrize("symmetrize", [True, False])
+@pytest.mark.parametrize("id_dtype", [np.int32, np.int64])
+def test_build_csr_w32_matches_generic(symmetrize, id_dtype):
+    """Weighted index-payload builder (cv_build_csr_w32): identical CSR to
+    the generic f64-payload path after the f32 policy cast, for both input
+    id widths (no width conversion happens natively)."""
+    from cuvite_tpu.core.graph import Graph
+
+    nv, ne = 257, 4096
+    src, dst, w = _random_edges(ne, nv, seed=11)
+    o, t, wf = native.build_csr_w(nv, src.astype(id_dtype),
+                                  dst.astype(id_dtype), w,
+                                  symmetrize=symmetrize)
+    g = Graph.from_edges(nv, src, dst, weights=w, symmetrize=symmetrize)
+    assert np.array_equal(o, g.offsets)
+    assert np.array_equal(t.astype(g.tails.dtype), g.tails)
+    assert np.array_equal(wf, g.weights)
+
+
+def test_build_csr_w32_radix_branch_large_nv():
+    """nv > 2^22 puts the generic path on its radix branch and enables the
+    from_edges w32 dispatch gate; both must agree bit-for-bit."""
+    from cuvite_tpu.core.graph import Graph
+
+    nv = (1 << 22) + 19
+    ne = native.MIN_NATIVE_EDGES + 512  # also crosses the dispatch gate
+    rng = np.random.default_rng(13)
+    src = rng.integers(nv - 500, nv, size=ne)
+    dst = rng.integers(nv - 500, nv, size=ne)
+    src[: ne // 4] = src[ne // 2: ne // 2 + ne // 4]
+    dst[: ne // 4] = dst[ne // 2: ne // 2 + ne // 4]
+    w = rng.random(ne)
+    o, t, wf = native.build_csr_w(nv, src, dst, w, symmetrize=True)
+    old = native._LIB
+    native._LIB = False
+    try:
+        g = Graph.from_edges(nv, src, dst, weights=w, symmetrize=True)
+    finally:
+        native._LIB = old
+    assert np.array_equal(o, g.offsets)
+    assert np.array_equal(t.astype(g.tails.dtype), g.tails)
+    assert np.array_equal(wf, g.weights)
+    # from_edges with the native lib enabled dispatches to the same path.
+    g2 = Graph.from_edges(nv, src, dst, weights=w, symmetrize=True)
+    assert np.array_equal(g2.weights, g.weights)
+    assert np.array_equal(g2.tails, g.tails)
