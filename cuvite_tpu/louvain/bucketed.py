@@ -649,38 +649,50 @@ def _row_argmax_sorted(cmat, wmat, aymat, smat, curr_comm, vdeg_v, sl_v,
                      best_size=best_size)
 
 
-def _rows_chunked(cmat, w_mat, dst_mat, curr, vdeg_v, sl_v, ax_v,
-                  constant, sentinel, gather_ay, gather_sz, id_bound=None):
+def _map_chunks(fn, nb, chunk, row_arrays):
+    """Shared chunk dispatch: run ``fn`` over [chunk]-row slices of
+    ``row_arrays`` via lax.map, or in one piece when ``nb`` doesn't divide
+    (row counts are pow2-padded and ``chunk_for_width`` returns pow2, so
+    the divisibility check only fails for sub-chunk buckets).  Returns the
+    lax.map-stacked pytree — callers reshape leading dims back to [nb].
+    One definition so the dispatch rule cannot drift between the argmax
+    pass and the modularity c0 pass."""
+    if nb <= chunk or nb % chunk != 0:
+        return fn(*row_arrays)
+    nchunk = nb // chunk
+    return jax.lax.map(
+        lambda args: fn(*args),
+        tuple(a.reshape((nchunk, chunk) + a.shape[1:]) for a in row_arrays),
+    )
+
+
+def _rows_chunked(w_mat, dst_mat, curr, vdeg_v, sl_v, ax_v,
+                  constant, sentinel, gather_cm, gather_ay, gather_sz,
+                  wdt, id_bound=None):
     """Dispatch rows to the right dedup variant, chunked with lax.map to
-    bound intermediate memory.  ``gather_ay``/``gather_sz`` produce the
-    per-slot community degree / size matrices from (dst_chunk, cmat_chunk)
-    INSIDE each chunk, so the transient [chunk, D] gathers never materialize
-    at full bucket size (``gather_sz`` may return None in replicated mode)."""
-    nb, width = cmat.shape
+    bound intermediate memory.  Every O(rows x D) operand that is not a
+    phase-static plan constant is produced INSIDE the chunk body:
+    ``gather_cm`` maps a dst chunk to its community matrix, ``gather_ay``/
+    ``gather_sz`` produce the per-slot community degree / size matrices,
+    and uint8-compressed unit weights widen to ``wdt`` per chunk.  XLA
+    cannot fuse producers into a lax.map (scan) body, so a full-bucket
+    cmat gather or weight cast at the caller would materialize the whole
+    O(E) matrix — at benchmark scale, tens of GB of step-resident
+    buffers (the scale-26 attempt-1 OOM, tools/scale26_attempts.md).
+    ``gather_sz`` may return None in replicated mode."""
+    nb, width = dst_mat.shape
     kernel = (_row_argmax if width <= QUADRATIC_MAX_WIDTH
               else functools.partial(_row_argmax_sorted, id_bound=id_bound))
-    chunk = chunk_for_width(width)
 
-    def run(cm, wm, dm, cu, vd, sl, ax):
+    def run(wm, dm, cu, vd, sl, ax):
+        if wm.dtype != wdt:  # uint8-compressed unit weights
+            wm = wm.astype(wdt)
+        cm = gather_cm(dm)
         return kernel(cm, wm, gather_ay(dm, cm), gather_sz(dm, cm),
                       cu, vd, sl, ax, constant, sentinel)
 
-    if nb <= chunk or nb % chunk != 0:
-        return run(cmat, w_mat, dst_mat, curr, vdeg_v, sl_v, ax_v)
-    nchunk = nb // chunk
-
-    res = jax.lax.map(
-        lambda args: run(*args),
-        (
-            cmat.reshape(nchunk, chunk, -1),
-            w_mat.reshape(nchunk, chunk, -1),
-            dst_mat.reshape(nchunk, chunk, -1),
-            curr.reshape(nchunk, chunk),
-            vdeg_v.reshape(nchunk, chunk),
-            sl_v.reshape(nchunk, chunk),
-            ax_v.reshape(nchunk, chunk),
-        ),
-    )
+    res = _map_chunks(run, nb, chunk_for_width(width),
+                      (w_mat, dst_mat, curr, vdeg_v, sl_v, ax_v))
     return RowResult(
         best_c=res.best_c.reshape(nb),
         best_gain=res.best_gain.reshape(nb),
@@ -727,14 +739,23 @@ def bucketed_modularity(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
         num_segments=nv_local,
     )
     for verts, dst_mat, w_mat in bucket_arrays:
-        if w_mat.dtype != wdt:   # uint8-compressed unit weights
-            w_mat = w_mat.astype(wdt)
         safe_v = jnp.minimum(verts, nv_local - 1)
         curr = jnp.take(comm, safe_v)
-        cmat = jnp.take(comm_full, dst_mat)
-        c0_rows = jnp.sum(
-            jnp.where(cmat == curr[:, None], w_mat, 0.0), axis=1
-        ).astype(wdt)
+
+        def c0_of(wm, dm, cu):
+            # Gather + uint8 widening INSIDE the chunk (same reasoning as
+            # _rows_chunked: producers can't fuse into a lax.map body, so
+            # doing this at full bucket size materializes O(E) buffers).
+            if wm.dtype != wdt:
+                wm = wm.astype(wdt)
+            cm = jnp.take(comm_full, dm)
+            return jnp.sum(
+                jnp.where(cm == cu[:, None], wm, 0.0), axis=1
+            ).astype(wdt)
+
+        nb, width = dst_mat.shape
+        c0_rows = _map_chunks(c0_of, nb, chunk_for_width(width),
+                              (w_mat, dst_mat, curr)).reshape(nb)
         counter0 = counter0.at[verts].add(c0_rows, mode="drop")
     if use_sparse:
         mod = sparse_modularity(counter0, env.deg_local, constant,
@@ -856,13 +877,13 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
                  else [False] * len(bucket_arrays))
     parts = []   # (verts, best_c, best_gain, counter0, best_size|None)
     for i, (verts, dst_mat, w_mat) in enumerate(bucket_arrays):
-        if w_mat.dtype != wdt:   # uint8-compressed unit weights
-            w_mat = w_mat.astype(wdt)
         safe_v = jnp.minimum(verts, nv_local - 1)
         curr = jnp.take(comm, safe_v)
         if is_pallas[i]:
             from cuvite_tpu.kernels.row_argmax import row_argmax_pallas
 
+            if w_mat.dtype != wdt:   # uint8-compressed unit weights
+                w_mat = w_mat.astype(wdt)
             cmat_t = jnp.take(comm_ref, dst_mat)   # [D, Nb]
             vdeg_v = jnp.take(vdeg, safe_v)
             bc, bg, c0_rows = row_argmax_pallas(
@@ -873,12 +894,13 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
             )
             parts.append((verts, bc.astype(vdt), bg, c0_rows, None))
             continue
-        cmat = jnp.take(comm_ref, dst_mat)
         vdeg_v = jnp.take(vdeg, safe_v)
-        res = _rows_chunked(cmat, w_mat, dst_mat,
+        res = _rows_chunked(w_mat, dst_mat,
                             curr, vdeg_v, jnp.take(self_loop, safe_v),
                             own_deg(safe_v) - vdeg_v,
-                            constant, sentinel, slot_ay, slot_size,
+                            constant, sentinel,
+                            lambda dm: jnp.take(comm_ref, dm),
+                            slot_ay, slot_size, wdt,
                             id_bound=nv_total)
         parts.append((verts, res.best_c, res.best_gain, res.counter0,
                       res.best_size))
