@@ -43,6 +43,16 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compile cache for the suite: a full-suite run compiles
+# hundreds of programs, and the cumulative LLVM state is what triggers
+# the late-run segfault above (the crash site is always inside an
+# XLA:CPU compile).  With the content-addressed disk cache, warm runs
+# skip LLVM for every previously seen program — removing both most of
+# the wall time and most of the crash exposure.
+from cuvite_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -98,8 +98,10 @@ def stage_a2(jnp, np):
 
 
 def stage_d(platform):
+    # fused = one host sync per RUN (vs per phase): over a ~1s-rtt tunnel
+    # the per-phase syncs alone are a visible share of a scale-18 run.
     for scale in (18, 20):
-        for engine in ("bucketed", "pallas"):
+        for engine in ("bucketed", "pallas", "fused"):
             cmd = [sys.executable, "-m", "cuvite_tpu.cli",
                    "--rmat", str(scale), "--engine", engine,
                    "--platform", platform, "--json", "--quiet"]

@@ -1,0 +1,65 @@
+"""Measured weighted edge-list -> CSR ingest at benchmark scale.
+
+VERDICT r3 item 8: the r3 generic weighted path OOM-killed a scale-26
+coalesce at 131 GB.  This records the r4 `cv_build_csr_w32` path
+(int32-index-payload radix; cuvite_tpu/core/graph.py dispatch) on a
+weighted R-MAT edge list: wall, coalesced edges, and RSS high-water.
+
+Usage: python tools/weighted_ingest_bench.py [scale] [edge_factor]
+Appends one line to tools/weighted_ingest.log.
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def hwm_mb():
+    with open("/proc/self/status") as f:
+        s = f.read()
+    return int(s.split("VmHWM:")[1].split()[0]) // 1024
+
+
+def main():
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else 25
+    ef = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+    from cuvite_tpu.core.graph import Graph
+    from cuvite_tpu import native
+
+    nv = 1 << scale
+    ne = ef * nv
+    t0 = time.perf_counter()
+    src, dst = native.rmat_edges(scale, ne, 1, 0.57, 0.19, 0.19)
+    # Deterministic synthetic weights (the R-MAT family is unweighted;
+    # weights here only exercise the weighted coalesce at scale).
+    w = ((src ^ dst) % 97).astype(np.float64) / 13.0 + 0.5
+    gen_s = time.perf_counter() - t0
+    gen_hwm = hwm_mb()
+
+    # Record which builder the dispatch gate actually selects (the w32
+    # path needs expanded count < 2^31: at scale 26 ef=16, symmetrize
+    # doubles 2^30 edges to exactly 2^31 and the GENERIC path runs —
+    # don't let that number masquerade as a w32 measurement).
+    w32_gate = (len(src) >= native.MIN_NATIVE_EDGES and native.available()
+                and (1 << 22) < nv <= (1 << 31)
+                and 2 * len(src) < (1 << 31))
+    t1 = time.perf_counter()
+    g = Graph.from_edges(nv, src, dst, weights=w, symmetrize=True)
+    build_s = time.perf_counter() - t1
+    line = (f"weighted scale-{scale} ef={ef}: gen {gen_s:.0f}s "
+            f"(hwm {gen_hwm} MB), from_edges {build_s:.0f}s "
+            f"path={'w32' if w32_gate else 'generic'}, "
+            f"nv={g.num_vertices} ne={g.num_edges} "
+            f"wdtype={g.weights.dtype} total_hwm={hwm_mb()} MB")
+    print(line)
+    with open(os.path.join(REPO, "tools", "weighted_ingest.log"), "a") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
