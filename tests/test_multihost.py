@@ -11,6 +11,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -206,24 +207,28 @@ def test_four_process_dist_ingest_rmat15(tmp_path):
                    for i in range(nproc))
 
     procs, outs = launch()
+    for _retry in range(2):
+        if results_complete() or not any(
+                "DEADLINE_EXCEEDED" in o for o in outs):
+            break
+        # Gloo's kv-store wait and the coordination-service shutdown
+        # barrier have fixed ~30 s deadlines with no knob; on this
+        # 1-core host a full-suite run (other xdist workers compiling)
+        # can starve one of the 4 processes past them.  Scheduler
+        # artifact, not a correctness signal — retry (at most twice)
+        # on the specific signature, after letting the compile burst
+        # pass.  A genuine failure (assertion, crash) does not match
+        # and still fails below.
+        time.sleep(45)
+        for i in range(nproc):
+            (tmp_path / f"dv4comm.{i}.npy").unlink(missing_ok=True)
+            (tmp_path / f"dv4info.{i}").unlink(missing_ok=True)
+        procs, outs = launch()
     if not results_complete():
-        if any("DEADLINE_EXCEEDED" in o for o in outs):
-            # Gloo's kv-store wait and the coordination-service shutdown
-            # barrier have fixed ~30 s deadlines with no knob; on this
-            # 1-core host a full-suite run (other xdist workers
-            # compiling) can starve one of the 4 processes past them.
-            # Scheduler artifact, not a correctness signal — retry once
-            # on the specific signature.  A genuine failure (assertion,
-            # crash) does not match and still fails below.
-            for i in range(nproc):
-                (tmp_path / f"dv4comm.{i}.npy").unlink(missing_ok=True)
-                (tmp_path / f"dv4info.{i}").unlink(missing_ok=True)
-            procs, outs = launch()
-        if not results_complete():
-            # Same leniency on the retry: returncodes only matter when a
-            # worker ALSO failed to deliver results.
-            for p, o in zip(procs, outs):
-                assert p.returncode == 0, f"worker failed:\n{o[-3000:]}"
+        # Returncodes only matter when a worker ALSO failed to deliver
+        # results (same leniency on every attempt).
+        for p, o in zip(procs, outs):
+            assert p.returncode == 0, f"worker failed:\n{o[-3000:]}"
     # Every worker wrote its results BEFORE jax shutdown, so a nonzero
     # exit from a contention-starved shutdown barrier after that point
     # does not invalidate the run — the bit-identity assertions below
