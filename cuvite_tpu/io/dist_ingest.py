@@ -190,6 +190,41 @@ class DistVite:
 
     # ---- full-graph stand-ins (distributed reductions) --------------------
 
+    def content_fingerprint(self) -> int:
+        """Checkpoint fingerprint from per-shard content hashes combined
+        across processes (the DistVite analog of
+        utils.checkpoint.graph_fingerprint; VERDICT r4 item 7).
+
+        Hashes each owned shard's (base, bound, n, src, dst, w) and crc-chains
+        the allgathered per-shard digests in shard order, so every process
+        computes the same value without any host ever holding the full
+        edge list.  The digest covers the PARTITIONED layout: a resume
+        must use the same ingest mode and nshards (a stricter guard than
+        the full-ingest fingerprint, failing closed on partition drift)."""
+        import zlib
+
+        digests = []
+        for s in range(self.local_lo, self.local_hi):
+            sh = self.shards[s]
+            n = int(sh.n_real_edges)
+            h = zlib.crc32(
+                np.asarray([sh.base, sh.bound, n], dtype=np.int64).tobytes())
+            # src is REQUIRED content: without it, shifting the same dst
+            # multiset across source rows (the row-boundary change
+            # graph_fingerprint catches via CSR offsets) would collide.
+            h = zlib.crc32(
+                np.ascontiguousarray(sh.src[:n]).view(np.uint8), h)
+            h = zlib.crc32(
+                np.ascontiguousarray(sh.dst[:n]).view(np.uint8), h)
+            h = zlib.crc32(np.ascontiguousarray(sh.w[:n]).view(np.uint8), h)
+            digests.append(h)
+        all_digests = np.concatenate(allgather_varlen(
+            np.asarray(digests, dtype=np.int64)))
+        h = 0
+        for v in all_digests:
+            h = zlib.crc32(np.int64(v).tobytes(), h)
+        return (h << 16) ^ (self.num_vertices & 0xFFFF)
+
     def modularity(self, comm_pad: np.ndarray) -> float:
         """f64 modularity of padded-space labels: local-slab e-term +
         degree-vector a-term, combined across processes (the analog of

@@ -197,6 +197,17 @@ def build_csr_w(num_vertices: int, src: np.ndarray, dst: np.ndarray,
         dst = np.ascontiguousarray(dst, dtype=np.int64)
     w = np.ascontiguousarray(w, dtype=np.float64)
     cap = max(2 * len(src) if symmetrize else len(src), 1)
+    # Validate BEFORE allocating the outputs: at ne near 2^31 the arrays
+    # below are ~16 GB, and the native call would only then reject the
+    # sizes with one conflated error.
+    if num_vertices > (1 << 31):
+        raise ValueError(
+            f"build_csr_w: num_vertices={num_vertices} exceeds the int32 "
+            f"tail id space (2^31); use the generic build_csr path")
+    if cap >= (1 << 31):
+        raise ValueError(
+            f"build_csr_w: expanded edge count {cap} exceeds the int32 "
+            f"index payload (2^31); use the generic build_csr path")
     offsets = np.empty(num_vertices + 1, dtype=np.int64)
     tails = np.empty(cap, dtype=np.int32)
     wout = np.empty(cap, dtype=np.float32)
@@ -204,9 +215,7 @@ def build_csr_w(num_vertices: int, src: np.ndarray, dst: np.ndarray,
                              w, int(src.dtype == np.int64),
                              int(symmetrize), offsets, tails, wout)
     if n < 0:
-        raise ValueError(
-            "edge endpoint out of range, nv > 2^31, or expanded edge "
-            "count >= 2^31")
+        raise ValueError("build_csr_w: edge endpoint out of range")
     return offsets, tails[:n].copy(), wout[:n].copy()
 
 
@@ -288,12 +297,35 @@ def _mem_available_bytes():
     except (OSError, ValueError, IndexError):
         pass
     # cgroup v2 (memory.max) then v1 (memory.limit_in_bytes): limit minus
-    # current usage, ignored when unlimited ("max" / huge sentinel).
-    for lim_path, cur_path in (
-        ("/sys/fs/cgroup/memory.max", "/sys/fs/cgroup/memory.current"),
-        ("/sys/fs/cgroup/memory/memory.limit_in_bytes",
-         "/sys/fs/cgroup/memory/memory.usage_in_bytes"),
-    ):
+    # current usage, ignored when unlimited ("max" / huge sentinel).  In a
+    # nested cgroup without a cgroup namespace the process's own limit
+    # lives under the subpath from /proc/self/cgroup, so probe every
+    # ancestor of that path down to the mount root (ADVICE r4).
+    v2_paths = ["/sys/fs/cgroup/memory.max"]
+    v1_paths = ["/sys/fs/cgroup/memory/memory.limit_in_bytes"]
+    try:
+        with open("/proc/self/cgroup") as f:
+            for line in f:
+                hid, ctrl, path = line.rstrip("\n").split(":", 2)
+                path = path.strip("/")
+                parts = path.split("/") if path else []
+                sub = [
+                    "/".join(parts[:i]) for i in range(len(parts), 0, -1)
+                ]
+                if hid == "0" and not ctrl:  # v2 unified
+                    v2_paths[:0] = [
+                        f"/sys/fs/cgroup/{s}/memory.max" for s in sub]
+                elif "memory" in ctrl.split(","):
+                    v1_paths[:0] = [
+                        f"/sys/fs/cgroup/memory/{s}/memory.limit_in_bytes"
+                        for s in sub]
+    except (OSError, ValueError):
+        pass
+    probes = [(p, p[: -len("memory.max")] + "memory.current")
+              for p in v2_paths]
+    probes += [(p, p[: -len("memory.limit_in_bytes")]
+                + "memory.usage_in_bytes") for p in v1_paths]
+    for lim_path, cur_path in probes:
         try:
             with open(lim_path) as f:
                 raw = f.read().strip()
@@ -305,8 +337,8 @@ def _mem_available_bytes():
             with open(cur_path) as f:
                 used = int(f.read().strip())
             head = max(limit - used, 0)
+            # The binding limit is the MIN over every level that has one.
             avail = head if avail is None else min(avail, head)
-            break
         except (OSError, ValueError):
             continue
     return avail

@@ -77,11 +77,60 @@ def test_distvite_modularity_oracle(karate_bin):
 def test_distvite_rejects_unsupported_modes(karate_bin):
     path, _ = karate_bin
     dv = DistVite.load(path, 8)
-    with pytest.raises(ValueError, match="coloring"):
-        louvain_phases(dv, coloring=4)
     with pytest.raises(ValueError, match="sparse"):
         louvain_phases(dv, exchange="replicated")
-    with pytest.raises(ValueError, match="fingerprint|full"):
-        louvain_phases(dv, checkpoint_dir="/tmp/nope")
     with pytest.raises(ValueError, match="bucketed"):
         louvain_phases(dv, engine="sort")
+
+
+def test_distvite_coloring_matches_full_ingest(karate_bin):
+    """Distributed coloring rounds (multi_hash_coloring_dist) + per-class
+    stacked plans on the per-host partition: colors AND the full -c/-d
+    clustering are bit-identical to the full-ingest run (VERDICT r4 item
+    7; the reference's distributed coloring, coloring.cpp:204-420)."""
+    from cuvite_tpu.louvain.coloring import (
+        multi_hash_coloring, multi_hash_coloring_dist,
+    )
+
+    path, g = karate_bin
+    dv = DistVite.load(path, 8)
+    colors_dist, nc_dist = multi_hash_coloring_dist(dv, n_hash=2)
+    colors_full, nc_full = multi_hash_coloring(
+        g.sources().astype(np.int32), g.tails.astype(np.int32),
+        g.num_vertices, n_hash=2)
+    assert nc_dist == nc_full
+    assert np.array_equal(colors_dist, colors_full)
+
+    for kw in ({"coloring": 4}, {"vertex_ordering": 4}):
+        res_dv = louvain_phases(dv, **kw)
+        res_full = louvain_phases(g, nshards=8, **kw)
+        assert np.array_equal(res_dv.communities, res_full.communities), kw
+        assert res_dv.modularity == pytest.approx(
+            res_full.modularity, abs=1e-9)
+
+
+def test_distvite_checkpoint_resume(karate_bin, tmp_path):
+    """Checkpoint fingerprints from per-shard content hashes: a DistVite
+    run checkpoints per phase, resumes to the uninterrupted result, and a
+    different graph's checkpoint is rejected (VERDICT r4 item 7)."""
+    path, g = karate_bin
+    dv = DistVite.load(path, 8)
+    full = louvain_phases(dv)
+    ckpt = str(tmp_path / "ck")
+    part = louvain_phases(dv, checkpoint_dir=ckpt, max_phases=1)
+    assert len(part.phases) == 1  # actually stopped early
+    res = louvain_phases(dv, checkpoint_dir=ckpt, resume=True)
+    assert np.array_equal(res.communities, full.communities)
+    assert res.modularity == pytest.approx(full.modularity, abs=1e-12)
+
+    # fingerprint guard: a checkpoint from ANOTHER graph is rejected
+    from cuvite_tpu.core.graph import Graph
+    from cuvite_tpu.io.vite import write_vite
+
+    ring = np.arange(16, dtype=np.int64)
+    other = Graph.from_edges(16, ring, (ring + 1) % 16)
+    p2 = str(tmp_path / "ring.bin")
+    write_vite(p2, other)
+    dv2 = DistVite.load(p2, 8)
+    with pytest.raises(ValueError, match="fingerprint"):
+        louvain_phases(dv2, checkpoint_dir=ckpt, resume=True)

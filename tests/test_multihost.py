@@ -7,6 +7,7 @@ connect through jax.distributed.initialize over localhost, form one global
 single-process run of the same graph.
 """
 
+import ast
 import os
 import socket
 import subprocess
@@ -71,7 +72,11 @@ res = louvain_phases(dv)
 np.save(os.path.join(out_dir, f"dvcomm.{proc}.npy"), res.communities)
 with open(os.path.join(out_dir, f"dvmod.{proc}"), "w") as f:
     f.write(repr(float(res.modularity)))
-print(f"proc {proc}: OK Q={res.modularity:.6f}")
+# Distributed coloring on the per-host partition (VERDICT r4 item 7):
+# per-round owned-slice allgather + per-class stacked plans.
+resc = louvain_phases(dv, coloring=2)
+np.save(os.path.join(out_dir, f"dvcomm_c.{proc}.npy"), resc.communities)
+print(f"proc {proc}: OK Q={res.modularity:.6f} Qc={resc.modularity:.6f}")
 """
 
 
@@ -233,6 +238,11 @@ def test_four_process_dist_ingest_rmat15(tmp_path):
     # exit from a contention-starved shutdown barrier after that point
     # does not invalidate the run — the bit-identity assertions below
     # are the test, and they run against complete result sets only.
+    # Keep such teardown crashes VISIBLE in CI output though (ADVICE r4).
+    for i, (p, o) in enumerate(zip(procs, outs)):
+        if p.returncode != 0:
+            print(f"# worker {i} exited rc={p.returncode} after writing "
+                  f"results (teardown crash?):\n{o[-1500:]}")
     assert results_complete(), (
         "workers exited without writing results:\n"
         + "\n---\n".join(o[-1200:] for o in outs))
@@ -240,7 +250,7 @@ def test_four_process_dist_ingest_rmat15(tmp_path):
     comms = [np.load(tmp_path / f"dv4comm.{i}.npy") for i in range(nproc)]
     for c in comms[1:]:
         assert np.array_equal(comms[0], c), "processes disagree"
-    infos = [eval(open(tmp_path / f"dv4info.{i}").read())
+    infos = [ast.literal_eval(open(tmp_path / f"dv4info.{i}").read())
              for i in range(nproc)]
     shards_seen = sorted(s for _, gc in infos for s in gc)
     assert shards_seen == list(range(8)), shards_seen
@@ -288,3 +298,9 @@ def test_two_process_dist_ingest(tmp_path):
     assert np.array_equal(c0, ref.communities)
     q0 = float(open(tmp_path / "dvmod.0").read())
     assert abs(q0 - ref.modularity) < 1e-6
+    # Distributed-coloring run: processes agree and match full ingest.
+    cc0 = np.load(tmp_path / "dvcomm_c.0.npy")
+    cc1 = np.load(tmp_path / "dvcomm_c.1.npy")
+    assert np.array_equal(cc0, cc1)
+    refc = louvain_phases(g, nshards=8, coloring=2)
+    assert np.array_equal(cc0, refc.communities)

@@ -1,0 +1,66 @@
+"""A/B the e65cc15 mechanisms at step level (judge-bisected 7x regression).
+
+Driver-identical single-shard bucketed phase-0 build at AB_SCALE (default
+18), honoring the two kill switches added for this investigation:
+
+  CUVITE_NO_ALIAS_UPLOAD=1   to_device() always copies (no DLPack alias)
+  CUVITE_NO_SLABLESS=1       driver uses the padded slab layout again
+
+Run one config per process (compile caches shared via tools/_common).
+Prints plan time, compile time, and min/median of N step walls.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: F401
+
+import numpy as np
+
+from cuvite_tpu.core.distgraph import DistGraph
+from cuvite_tpu.io.generate import generate_rmat
+from cuvite_tpu.louvain.driver import PhaseRunner
+
+
+def main():
+    import jax
+    scale = int(os.environ.get("AB_SCALE", "18"))
+    slabless = not os.environ.get("CUVITE_NO_SLABLESS")
+    alias = not os.environ.get("CUVITE_NO_ALIAS_UPLOAD")
+    print(f"# backend={jax.default_backend()} scale={scale} "
+          f"slabless={slabless} alias={alias}", flush=True)
+    g = generate_rmat(scale, edge_factor=16, seed=1)
+    t0 = time.perf_counter()
+    dg = DistGraph.build(g, 1, min_nv_pad=4096, min_ne_pad=16384,
+                         pad_edges=not slabless)
+    runner = PhaseRunner(dg, engine="bucketed", release_slabs=slabless)
+    _ = np.asarray(runner.comm0[0:1])
+    print(f"# plan+upload {time.perf_counter() - t0:.2f}s", flush=True)
+
+    def step(c):
+        return runner._step(None, None, None, c, runner.vdeg,
+                            runner.constant)
+
+    t0 = time.perf_counter()
+    out = step(runner.comm0)
+    _ = float(out[1])
+    print(f"# first call (compile) {time.perf_counter() - t0:.1f}s",
+          flush=True)
+
+    c = runner.comm0
+    times = []
+    for _ in range(6):
+        t0 = time.perf_counter()
+        tgt, mod, _, _ = step(c)
+        _ = float(mod)
+        times.append(time.perf_counter() - t0)
+        c = tgt
+    times.sort()
+    print(f"step min {times[0]*1e3:.0f} ms  med {times[3]*1e3:.0f} ms  "
+          f"all {[f'{t*1e3:.0f}' for t in times]}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
