@@ -1,0 +1,150 @@
+"""Pallas TPU kernel: community-range-tile bincount dedup + gain argmax
+for the HEAVY degree class (> 8192 neighbors per vertex).
+
+Role: the TPU counterpart of the reference GPU's huge-class kernel, which
+bincounts neighbor weights into a 20M-entry dense per-block scratch
+indexed by dense community id (distGetMaxIndex_large_new,
+/root/reference/louvain_cuda.cu:878-1022).  An O(nv) dense scratch cannot
+live in VMEM (~16 MB on v5e), so this kernel tiles the COMMUNITY RANGE
+(tools/heavy_kernel_design.md): for each tile [t*C, (t+1)*C) it one-hot
+matmuls the row's weights against `eq(c, cand)` — duplicate aggregation
+IS the bincount — and carries a running (best_gain, best_c) across tiles.
+
+Layout: transposed [D, H] rows (H = heavy vertices, D = max heavy degree,
+rows padded with c = pad id >= n_tiles*C and w = 0), one vertex per grid
+row.  The neighbor-community axis is reduced in Dc-sized chunks inside a
+fori_loop so VMEM holds only [Dc, C] one-hot blocks; `comm_deg` (the ay
+gather of the narrow kernel) arrives as a contiguous [1, C] block per
+community tile — a community-RANGE tile needs no gather at all.
+
+Tie-break matches the narrow kernel (`row_argmax.py`) and the reference
+(`louvain.cpp:2230-2238`): max gain, ties -> smaller community id.  Tiles
+ascend in community id, so a strict `>` merge keeps the earlier (smaller)
+id on cross-tile ties, and the in-tile rule picks the smallest candidate
+among equal gains.
+
+Status per the design note's decision rule: built for interpret-mode
+correctness + the staged chip A/B (tools/heavy_ab.py); the XLA global
+sort path remains the default until the chip measurement says otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+DEFAULT_C_TILE = 512     # communities per tile ([Dc, C] one-hot block)
+DEFAULT_D_CHUNK = 1024   # neighbor slots reduced per fori step
+# [Dc, C] f32 one-hot + eq intermediates must sit well under v5e VMEM.
+assert DEFAULT_C_TILE * DEFAULT_D_CHUNK * 4 <= (4 << 20)
+
+
+def _kernel(const_ref, cT_ref, wT_ref, ay_ref, curr_ref, vdeg_ref, sl_ref,
+            ax_ref, bc_ref, bg_ref, c0_ref, *, c_tile: int, d_chunk: int):
+    t = pl.program_id(1)
+    c = cT_ref[:]          # [D, 1] int32 neighbor communities (one vertex)
+    w = wT_ref[:]          # [D, 1] f32 edge weights (0 on padding)
+    ay = ay_ref[:]         # [1, C] f32 comm_deg of this community tile
+    curr = curr_ref[0, 0]  # scalars of the vertex
+    vdeg = vdeg_ref[0, 0]
+    sl = sl_ref[0, 0]
+    ax = ax_ref[0, 0]
+    const = const_ref[0]
+    wdt = w.dtype
+
+    @pl.when(t == 0)
+    def _init():
+        # counter0 (weight into the current community, incl. self edges)
+        # is row-local — one elementwise pass, no tiles involved.
+        c0_ref[0, 0] = jnp.sum(jnp.where(c == curr, w, 0.0))
+        bg_ref[0, 0] = jnp.asarray(-jnp.inf, dtype=wdt)
+        bc_ref[0, 0] = jnp.asarray(jnp.iinfo(cT_ref.dtype).max,
+                                   dtype=cT_ref.dtype)
+
+    eix = c0_ref[0, 0] - sl
+    cand = t * c_tile + jax.lax.broadcasted_iota(jnp.int32, (1, c_tile), 1)
+
+    def chunk(k, wagg):
+        ck = jax.lax.dynamic_slice_in_dim(c, k * d_chunk, d_chunk, axis=0)
+        wk = jax.lax.dynamic_slice_in_dim(w, k * d_chunk, d_chunk, axis=0)
+        eq = (ck == cand).astype(wdt)            # [Dc, C] one-hot
+        return wagg + jax.lax.dot_general(        # [1, C] bincount slice
+            wk, eq, (((0,), (0,)), ((), ())),
+            preferred_element_type=wdt)
+
+    n_chunks = cT_ref.shape[0] // d_chunk
+    wagg = jax.lax.fori_loop(
+        0, n_chunks, chunk, jnp.zeros((1, c_tile), dtype=wdt))
+
+    valid = (wagg > 0) & (cand != curr)
+    gain = 2.0 * (wagg - eix) - 2.0 * vdeg * const * (ay - ax)
+    gain = jnp.where(valid, gain, -jnp.inf)
+    tile_bg = jnp.max(gain)
+    big = jnp.asarray(jnp.iinfo(cT_ref.dtype).max, dtype=cand.dtype)
+    tile_bc = jnp.min(jnp.where(gain == tile_bg, cand, big))
+    better = tile_bg > bg_ref[0, 0]               # strict: earlier tile
+    bc_ref[0, 0] = jnp.where(
+        better, tile_bc.astype(cT_ref.dtype), bc_ref[0, 0])
+    bg_ref[0, 0] = jnp.where(better, tile_bg, bg_ref[0, 0])
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("c_tile", "d_chunk", "interpret"),
+)
+def heavy_argmax_pallas(cT, wT, comm_deg, curr, vdeg, sl, ax, constant, *,
+                        c_tile: int = DEFAULT_C_TILE,
+                        d_chunk: int = DEFAULT_D_CHUNK,
+                        interpret: bool = False):
+    """Run the heavy-class tile kernel.
+
+    cT/wT: [D, H] transposed heavy rows (one vertex per column; D a
+    multiple of ``d_chunk``; padding slots carry c >= n_tiles*c_tile and
+    w = 0).  comm_deg: [nv_ceil] community weighted degrees, nv_ceil a
+    multiple of ``c_tile`` (pad with zeros).  curr/vdeg/sl/ax: [H] per
+    vertex (sl = self-loop weight, ax = comm_deg[curr] - k_i).  Returns
+    (best_c [H] int, best_gain [H], counter0 [H]); best_c is the int-max
+    sentinel where no valid move exists (caller keeps such vertices in
+    place, same contract as the narrow kernel).
+    """
+    D, H = cT.shape
+    (nv_ceil,) = comm_deg.shape
+    assert D % d_chunk == 0, (D, d_chunk)
+    assert nv_ceil % c_tile == 0, (nv_ceil, c_tile)
+    grid = (H, nv_ceil // c_tile)
+
+    row_spec = pl.BlockSpec((D, 1), lambda r, t: (0, r),
+                            memory_space=pltpu.VMEM)
+    ay_spec = pl.BlockSpec((1, c_tile), lambda r, t: (0, t),
+                           memory_space=pltpu.VMEM)
+    scalar_spec = pl.BlockSpec((1, 1), lambda r, t: (0, r),
+                               memory_space=pltpu.VMEM)
+    out_shapes = (
+        jax.ShapeDtypeStruct((1, H), cT.dtype),
+        jax.ShapeDtypeStruct((1, H), wT.dtype),
+        jax.ShapeDtypeStruct((1, H), wT.dtype),
+    )
+    kernel = functools.partial(_kernel, c_tile=c_tile, d_chunk=d_chunk)
+    bc, bg, c0 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            row_spec, row_spec, ay_spec,
+            scalar_spec, scalar_spec, scalar_spec, scalar_spec,
+        ],
+        out_specs=(scalar_spec, scalar_spec, scalar_spec),
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(
+        jnp.reshape(constant, (1,)).astype(wT.dtype),
+        cT, wT, comm_deg.reshape(1, nv_ceil),
+        curr.reshape(1, H), vdeg.reshape(1, H), sl.reshape(1, H),
+        ax.reshape(1, H),
+    )
+    return bc.reshape(H), bg.reshape(H), c0.reshape(H)

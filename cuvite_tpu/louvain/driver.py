@@ -1258,15 +1258,16 @@ def louvain_phases(
                     f"checkpoint states {seen.tolist()} from "
                     f"{checkpoint_dir!r} — the checkpoint directory must "
                     "be shared storage visible to every process")
-        if ck is not None and ck.fingerprint != -1 \
-                and ck.fingerprint != _source_fingerprint(graph):
-            # Same directory, different graph content (e.g. same-scale R-MAT
-            # with another seed): composing its labels would be silently
-            # wrong, and silently restarting would hide the mistake.
-            raise ValueError(
-                f"checkpoint in {checkpoint_dir!r} was written for a "
-                "different graph (content fingerprint mismatch); use a "
-                "fresh --checkpoint-dir or drop --resume")
+        if ck is not None and ck.fingerprint != -1:
+            ck_fp = _source_fingerprint(graph)  # reused at save time
+            if ck.fingerprint != ck_fp:
+                # Same directory, different graph content (e.g. same-scale
+                # R-MAT with another seed): composing its labels would be
+                # silently wrong, and silently restarting would hide it.
+                raise ValueError(
+                    f"checkpoint in {checkpoint_dir!r} was written for a "
+                    "different graph (content fingerprint mismatch); use a "
+                    "fresh --checkpoint-dir or drop --resume")
         if ck is not None and len(ck.comm_all) == nv0 \
                 and ck.orig_ne == graph.num_edges:
             g = ck.graph

@@ -88,6 +88,73 @@ def test_row_argmax_pallas_no_candidates():
     assert np.allclose(np.asarray(c0), width)
 
 
+@pytest.mark.parametrize("seed", [0, 5])
+def test_heavy_bincount_matches_quadratic_oracle(seed):
+    """Heavy-class community-range-tile kernel (heavy_bincount.py) vs the
+    quadratic XLA fallback on the same rows: identical best_c/best_gain/
+    counter0 bit-for-bit (1/16-multiple weights make f32 sums exact in any
+    order, so the matmul-bincount and the all-pairs aggregation agree)."""
+    from cuvite_tpu.kernels.heavy_bincount import heavy_argmax_pallas
+
+    n_rows, width, nv = 64, 512, 500
+    nv_ceil, c_tile, d_chunk = 512, 128, 128
+    cmat, wmat, curr, vdeg, sl, comm_deg, constant = _bucket_case(
+        n_rows, width, nv, seed)
+    is_cc = cmat == curr[:, None]
+    counter0 = np.sum(np.where(is_cc, wmat, 0.0), axis=1).astype(np.float32)
+    ay = comm_deg[cmat]
+    ax = comm_deg[curr] - vdeg
+    ref = _row_argmax(
+        jnp.asarray(cmat), jnp.asarray(wmat), jnp.asarray(ay), None,
+        jnp.asarray(curr), jnp.asarray(vdeg), jnp.asarray(sl),
+        jnp.asarray(ax), jnp.asarray(constant), SENTINEL,
+    )
+    comm_deg_pad = np.zeros(nv_ceil, dtype=np.float32)
+    comm_deg_pad[:nv] = comm_deg
+    bc, bg, c0 = heavy_argmax_pallas(
+        jnp.asarray(np.ascontiguousarray(cmat.T)),
+        jnp.asarray(np.ascontiguousarray(wmat.T)),
+        jnp.asarray(comm_deg_pad),
+        jnp.asarray(curr), jnp.asarray(vdeg), jnp.asarray(sl),
+        jnp.asarray(ax), jnp.asarray(constant),
+        c_tile=c_tile, d_chunk=d_chunk, interpret=True,
+    )
+    assert np.array_equal(np.asarray(c0), counter0)
+    assert np.array_equal(np.asarray(bg), np.asarray(ref.best_gain))
+    assert np.array_equal(np.asarray(bc), np.asarray(ref.best_c))
+
+
+def test_heavy_bincount_padding_and_no_candidates():
+    """Padded slots (c = nv_ceil, w = 0) never contribute; rows whose
+    neighbors all sit in the current community return the sentinel."""
+    from cuvite_tpu.kernels.heavy_bincount import heavy_argmax_pallas
+
+    n_rows, width = 8, 256
+    nv, nv_ceil, c_tile, d_chunk = 100, 128, 128, 128
+    rng = np.random.default_rng(2)
+    curr = rng.integers(0, nv, size=n_rows).astype(np.int32)
+    cmat = np.full((n_rows, width), nv_ceil, dtype=np.int32)  # all padding
+    wmat = np.zeros((n_rows, width), dtype=np.float32)
+    # First half of the slots: real edges into the CURRENT community only.
+    cmat[:, : width // 2] = curr[:, None]
+    wmat[:, : width // 2] = 0.5
+    vdeg = np.ones(n_rows, dtype=np.float32)
+    sl = np.zeros(n_rows, dtype=np.float32)
+    comm_deg = np.ones(nv_ceil, dtype=np.float32)
+    ax = comm_deg[curr] - vdeg
+    bc, bg, c0 = heavy_argmax_pallas(
+        jnp.asarray(np.ascontiguousarray(cmat.T)),
+        jnp.asarray(np.ascontiguousarray(wmat.T)),
+        jnp.asarray(comm_deg),
+        jnp.asarray(curr), jnp.asarray(vdeg), jnp.asarray(sl),
+        jnp.asarray(ax), jnp.asarray(np.float32(0.01)),
+        c_tile=c_tile, d_chunk=d_chunk, interpret=True,
+    )
+    assert np.all(np.asarray(bc) == SENTINEL)
+    assert np.all(np.isneginf(np.asarray(bg)))
+    assert np.allclose(np.asarray(c0), 0.5 * (width // 2))
+
+
 def test_pallas_engine_end_to_end(karate):
     """engine='pallas' must produce the same result as engine='bucketed'
     through the full multi-phase driver (interpret mode on CPU)."""

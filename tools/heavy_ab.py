@@ -1,0 +1,131 @@
+"""Heavy-class A/B: community-range-tile Pallas kernel vs the XLA sorted
+path, on hub rows (the decision measurement of heavy_kernel_design.md).
+
+The kernel's cost is O(D * nv_ceil / C) matmul passes per row — linear in
+the COMMUNITY-SPACE size — while the sort path is O(D log^2 D) per row
+regardless of nv.  The sweep therefore times both over (D, nv_ceil) so
+the log records where (if anywhere) the tile kernel wins: the design
+note predicts only small nv_ceil (late coarsened phases) can favor it.
+
+Usage:
+    python tools/heavy_ab.py                   # default backend (chip)
+    CUVITE_PLATFORM=cpu python tools/heavy_ab.py   # interpret-mode smoke
+
+Appends a dated block to tools/heavy_ab_r5.log.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _common  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "tools", "heavy_ab_r5.log")
+
+
+def log(msg):
+    line = f"[{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}] {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def time_best(fn, n=5):
+    fn()  # compile + warm
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main():
+    from cuvite_tpu.kernels.heavy_bincount import heavy_argmax_pallas
+    from cuvite_tpu.louvain.bucketed import _row_argmax_sorted
+
+    interpret = jax.default_backend() != "tpu"
+    plat = jax.default_backend()
+    log(f"heavy A/B start backend={plat} interpret={interpret}")
+    H = 32  # hub rows per case (hubs are <0.1% of vertices)
+    rng = np.random.default_rng(7)
+    for D in (4096, 16384):
+        for nv_ceil in (8192, 65536, 1 << 20):
+            if interpret and (D, nv_ceil) != (4096, 8192):
+                # Interpret mode executes the grid in Python — the big
+                # cases would take hours; cpu is a correctness smoke only.
+                continue
+            nv = nv_ceil - 7
+            cmat = rng.integers(0, nv, size=(H, D)).astype(np.int32)
+            wmat = (rng.integers(1, 32, size=(H, D)) / 16.0).astype(
+                np.float32)
+            curr = rng.integers(0, nv, size=H).astype(np.int32)
+            vdeg = wmat.sum(axis=1)
+            sl = np.zeros(H, dtype=np.float32)
+            comm_deg = (rng.integers(1, 256, size=nv_ceil) / 8.0).astype(
+                np.float32)
+            ax = comm_deg[curr] - vdeg
+            const = np.float32(1.0 / vdeg.sum())
+            cT = jnp.asarray(np.ascontiguousarray(cmat.T))
+            wT = jnp.asarray(np.ascontiguousarray(wmat.T))
+            cd = jnp.asarray(comm_deg)
+            cu, vd, slj, axj = map(jnp.asarray, (curr, vdeg, sl, ax))
+
+            def run_kernel():
+                bc, bg, c0 = heavy_argmax_pallas(
+                    cT, wT, cd, cu, vd, slj, axj, jnp.asarray(const),
+                    interpret=interpret)
+                return float(bg[0])
+
+            # XLA twin: the per-row packed single-key sort path the heavy
+            # residual rides today, on identical rows.
+            cm = jnp.asarray(cmat)
+            wm = jnp.asarray(wmat)
+            ay = jnp.asarray(comm_deg[cmat])
+
+            def run_sorted():
+                res = _row_argmax_sorted(
+                    cm, wm, ay, None, cu, vd, slj, axj,
+                    jnp.asarray(const), np.iinfo(np.int32).max,
+                    id_bound=nv_ceil)
+                return float(res.best_gain[0])
+
+            try:
+                tk = time_best(run_kernel)
+            except Exception as e:  # mosaic lowering can reject shapes
+                log(f"D={D} nv_ceil={nv_ceil}: kernel FAILED {e!r:.200}")
+                continue
+            ts = time_best(run_sorted)
+            # Semantic identity on the A/B inputs: best_c/counter0 must be
+            # bitwise equal.  best_gain is compared to 1-2 ulp: const here
+            # is 1/sum(w) (not a power of two like the unit tests use), so
+            # XLA's FMA contraction rounds the gain's second term once
+            # where the non-contracted form rounds twice — measured 1 ulp
+            # on ~half the rows, never changing the argmax.
+            bk = heavy_argmax_pallas(cT, wT, cd, cu, vd, slj, axj,
+                                     jnp.asarray(const),
+                                     interpret=interpret)
+            br = _row_argmax_sorted(cm, wm, ay, None, cu, vd, slj, axj,
+                                    jnp.asarray(const),
+                                    np.iinfo(np.int32).max,
+                                    id_bound=nv_ceil)
+            gk, gr = np.asarray(bk[1]), np.asarray(br.best_gain)
+            fin = np.isfinite(gk) & np.isfinite(gr)
+            same = (np.array_equal(np.asarray(bk[0]),
+                                   np.asarray(br.best_c))
+                    and np.array_equal(fin, np.isfinite(gr))
+                    and np.allclose(gk[fin], gr[fin], rtol=3e-7, atol=0))
+            log(f"D={D} nv_ceil={nv_ceil} H={H}: kernel {tk*1e3:.1f} ms  "
+                f"sorted {ts*1e3:.1f} ms  ratio {tk/ts:.2f}x  "
+                f"semantically_identical={same}")
+    log("heavy A/B done")
+
+
+if __name__ == "__main__":
+    main()

@@ -51,31 +51,44 @@ def probe(timeout_s=75):
 
 
 def stage_c_retry():
-    env = dict(os.environ, BENCH_SCALE="18", BENCH_TIME_BUDGET="2000",
-               BENCH_REPEATS="3")
-    t0 = time.perf_counter()
-    with open(os.path.join(REPO, "tools", "bench18_tpu_stderr.log"),
-              "w") as errf:
-        out = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py")],
-            stdout=subprocess.PIPE, stderr=errf, text=True,
-            timeout=3000, env=env)
-    last = out.stdout.strip().splitlines()
-    log(f"C': bench scale=18 rc={out.returncode} "
-        f"wall={time.perf_counter()-t0:.0f}s "
-        f"json={last[-1] if last else '?'} "
-        f"(stderr: tools/bench18_tpu_stderr.log)")
-    if out.returncode == 0 and last:
+    """Round-5 bench-first order (VERDICT r4 item 2): scale 20 first
+    (bench.py's TPU default, the number BASELINE tracks), then scale 18
+    (comparable with every recorded CPU number).  Each stage checkpoints
+    its JSON to disk immediately, so a tunnel wedge mid-ladder cannot
+    lose an earlier stage's result; stderr is preserved per scale."""
+    got = False
+    for scale, budget in (("20", "1400"), ("18", "700")):
+        env = dict(os.environ, BENCH_SCALE=scale, BENCH_TIME_BUDGET=budget,
+                   BENCH_REPEATS="3")
+        t0 = time.perf_counter()
+        errpath = os.path.join(REPO, "tools",
+                               f"bench{scale}_tpu_stderr.log")
         try:
-            j = json.loads(last[-1])
-            if j.get("platform") != "cpu":
-                with open(os.path.join(REPO, "tools/bench_tpu_s18_r4.json"),
-                          "w") as f:
-                    f.write(last[-1] + "\n")
-                return True
-        except json.JSONDecodeError:
-            pass
-    return False
+            with open(errpath, "w") as errf:
+                out = subprocess.run(
+                    [sys.executable, os.path.join(REPO, "bench.py")],
+                    stdout=subprocess.PIPE, stderr=errf, text=True,
+                    timeout=int(budget) + 400, env=env)
+        except subprocess.TimeoutExpired:
+            log(f"C': bench scale={scale} TIMEOUT")
+            continue
+        last = out.stdout.strip().splitlines()
+        log(f"C': bench scale={scale} rc={out.returncode} "
+            f"wall={time.perf_counter()-t0:.0f}s "
+            f"json={last[-1] if last else '?'} "
+            f"(stderr: {errpath})")
+        if out.returncode == 0 and last:
+            try:
+                j = json.loads(last[-1])
+                if j.get("platform") != "cpu":
+                    with open(os.path.join(
+                            REPO, f"tools/bench_tpu_s{scale}_r5.json"),
+                            "w") as f:
+                        f.write(last[-1] + "\n")
+                    got = True
+            except json.JSONDecodeError:
+                pass
+    return got
 
 
 def main():
@@ -101,6 +114,14 @@ def main():
                        timeout=7200)
     except subprocess.TimeoutExpired:
         log("ladder2: TIMEOUT (7200s)")
+    # Heavy-class decision measurement (heavy_kernel_design.md): tile
+    # kernel vs XLA sorted path over (D, nv_ceil); its own dated log.
+    try:
+        subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "heavy_ab.py")],
+                       timeout=1800)
+    except subprocess.TimeoutExpired:
+        log("heavy_ab: TIMEOUT (1800s)")
     if got_tpu_json:
         with open(DONE, "w") as f:
             f.write(time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()) + "\n")
