@@ -69,20 +69,29 @@ def _kernel(const_ref, cT_ref, wT_ref, ay_ref, curr_ref, vdeg_ref, sl_ref,
     eix = c0_ref[0, 0] - sl
     cand = t * c_tile + jax.lax.broadcasted_iota(jnp.int32, (1, c_tile), 1)
 
-    def chunk(k, wagg):
+    def chunk(k, carry):
+        wagg, cnt = carry
         ck = jax.lax.dynamic_slice_in_dim(c, k * d_chunk, d_chunk, axis=0)
         wk = jax.lax.dynamic_slice_in_dim(w, k * d_chunk, d_chunk, axis=0)
         eq = (ck == cand).astype(wdt)            # [Dc, C] one-hot
-        return wagg + jax.lax.dot_general(        # [1, C] bincount slice
+        wagg = wagg + jax.lax.dot_general(        # [1, C] bincount slice
             wk, eq, (((0,), (0,)), ((), ())),
             preferred_element_type=wdt)
+        # Presence COUNT, not weight: zero-weight edges are candidates
+        # exactly as in the XLA paths (bucketed.py `_row_argmax` — 'No
+        # w>0 filter').  Padding slots carry c >= n_tiles*c_tile so eq
+        # never matches them.
+        cnt = cnt + jnp.sum(eq, axis=0, keepdims=True)
+        return wagg, cnt
 
     n_chunks = cT_ref.shape[0] // d_chunk
-    wagg = jax.lax.fori_loop(
-        0, n_chunks, chunk, jnp.zeros((1, c_tile), dtype=wdt))
+    zero = jnp.zeros((1, c_tile), dtype=wdt)
+    wagg, cnt = jax.lax.fori_loop(0, n_chunks, chunk, (zero, zero))
 
-    valid = (wagg > 0) & (cand != curr)
-    gain = 2.0 * (wagg - eix) - 2.0 * vdeg * const * (ay - ax)
+    valid = (cnt > 0) & (cand != curr)
+    # Operand order matches the XLA paths exactly (bucketed.py:546/633):
+    # 2*(wagg-eix) - ((2*vdeg)*(ay-ax))*const.
+    gain = 2.0 * (wagg - eix) - 2.0 * vdeg * (ay - ax) * const
     gain = jnp.where(valid, gain, -jnp.inf)
     tile_bg = jnp.max(gain)
     big = jnp.asarray(jnp.iinfo(cT_ref.dtype).max, dtype=cand.dtype)

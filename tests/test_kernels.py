@@ -124,6 +124,44 @@ def test_heavy_bincount_matches_quadratic_oracle(seed):
     assert np.array_equal(np.asarray(bc), np.asarray(ref.best_c))
 
 
+def test_heavy_bincount_zero_weight_edges_are_candidates():
+    """A community reached only by a w=0 edge is still a valid move target
+    (same invariant as the XLA paths: 'No w>0 filter').  Its gain
+    -2*eix - 2*vdeg*const*(ay-ax) can win when ay < ax."""
+    from cuvite_tpu.kernels.heavy_bincount import heavy_argmax_pallas
+
+    n_rows, width, nv = 16, 128, 120
+    nv_ceil, c_tile, d_chunk = 128, 128, 128
+    rng = np.random.default_rng(9)
+    cmat = rng.integers(0, nv, size=(n_rows, width)).astype(np.int32)
+    wmat = (rng.integers(0, 4, size=(n_rows, width)) / 16.0).astype(
+        np.float32)  # ~1/4 of edges have weight 0
+    curr = rng.integers(0, nv, size=n_rows).astype(np.int32)
+    vdeg = np.maximum(wmat.sum(axis=1), 0.25).astype(np.float32)
+    sl = np.zeros(n_rows, dtype=np.float32)
+    comm_deg = (rng.integers(1, 64, size=nv) / 8.0).astype(np.float32)
+    ay = comm_deg[cmat]
+    ax = comm_deg[curr] - vdeg
+    constant = np.float32(1.0 / 16.0)
+    ref = _row_argmax(
+        jnp.asarray(cmat), jnp.asarray(wmat), jnp.asarray(ay), None,
+        jnp.asarray(curr), jnp.asarray(vdeg), jnp.asarray(sl),
+        jnp.asarray(ax), jnp.asarray(constant), SENTINEL,
+    )
+    cdp = np.zeros(nv_ceil, dtype=np.float32)
+    cdp[:nv] = comm_deg
+    bc, bg, c0 = heavy_argmax_pallas(
+        jnp.asarray(np.ascontiguousarray(cmat.T)),
+        jnp.asarray(np.ascontiguousarray(wmat.T)),
+        jnp.asarray(cdp),
+        jnp.asarray(curr), jnp.asarray(vdeg), jnp.asarray(sl),
+        jnp.asarray(ax), jnp.asarray(constant),
+        c_tile=c_tile, d_chunk=d_chunk, interpret=True,
+    )
+    assert np.array_equal(np.asarray(bg), np.asarray(ref.best_gain))
+    assert np.array_equal(np.asarray(bc), np.asarray(ref.best_c))
+
+
 def test_heavy_bincount_padding_and_no_candidates():
     """Padded slots (c = nv_ceil, w = 0) never contribute; rows whose
     neighbors all sit in the current community return the sentinel."""

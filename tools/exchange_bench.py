@@ -12,6 +12,7 @@ Usage:
 """
 
 import os
+import subprocess
 import sys
 import time
 
@@ -31,26 +32,62 @@ from cuvite_tpu.io.generate import generate_rmat  # noqa: E402
 from cuvite_tpu.louvain.driver import louvain_phases  # noqa: E402
 
 
+def _vm_hwm_mib():
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmHWM:"):
+                    return int(line.split()[1]) // 1024
+    except OSError:
+        pass
+    return -1
+
+
+def run_one(scale: int, nsh: int, exchange: str):
+    g = generate_rmat(scale, edge_factor=16, seed=1)
+    # warm-up run eats compiles; timed run is steady-state
+    louvain_phases(g, nshards=nsh, exchange=exchange)
+    t0 = time.perf_counter()
+    res = louvain_phases(g, nshards=nsh, exchange=exchange)
+    wall = time.perf_counter() - t0
+    print(f"scale={scale} exchange={exchange:10s} wall={wall:8.1f}s "
+          f"Q={res.modularity:.5f} iters={res.total_iterations} "
+          f"rss_hwm={_vm_hwm_mib()}MiB",
+          flush=True)
+    return wall
+
+
 def main():
     scales = [int(s) for s in os.environ.get("AB_SCALES", "18 20").split()]
     nsh = int(os.environ.get("AB_SHARDS", "8"))
+    one = os.environ.get("AB_EXCHANGE")  # subprocess mode: one config
     print(f"# backend={jax.default_backend()} "
           f"devices={len(jax.devices())} shards={nsh}", flush=True)
+    if one:
+        for scale in scales:
+            run_one(scale, nsh, one)
+        return
     for scale in scales:
-        g = generate_rmat(scale, edge_factor=16, seed=1)
         row = {}
         for exchange in ("replicated", "sparse"):
-            # warm-up run eats compiles; timed run is steady-state
-            louvain_phases(g, nshards=nsh, exchange=exchange)
-            t0 = time.perf_counter()
-            res = louvain_phases(g, nshards=nsh, exchange=exchange)
-            wall = time.perf_counter() - t0
-            row[exchange] = (wall, res.modularity, res.total_iterations)
-            print(f"scale={scale} exchange={exchange:10s} wall={wall:8.1f}s "
-                  f"Q={res.modularity:.5f} iters={res.total_iterations}",
-                  flush=True)
-        r, s = row["replicated"][0], row["sparse"][0]
-        print(f"scale={scale} sparse/replicated = {s / r:.2f}x", flush=True)
+            # Per-config SUBPROCESS: independent RSS high-water (the
+            # sparse plan's whole point is the memory footprint) and no
+            # shared jit caches between the two configs.
+            env = dict(os.environ, AB_SCALES=str(scale), AB_EXCHANGE=exchange,
+                       AB_SHARDS=str(nsh))
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True)
+            print(out.stdout.strip().splitlines()[-1]
+                  if out.stdout.strip() else
+                  f"scale={scale} exchange={exchange}: rc={out.returncode} "
+                  f"{(out.stderr or '')[-400:]}", flush=True)
+            for line in out.stdout.splitlines():
+                if line.startswith(f"scale={scale} exchange={exchange}"):
+                    row[exchange] = float(line.split("wall=")[1].split("s")[0])
+        if "replicated" in row and "sparse" in row:
+            print(f"scale={scale} sparse/replicated = "
+                  f"{row['sparse'] / row['replicated']:.2f}x", flush=True)
 
 
 if __name__ == "__main__":

@@ -103,11 +103,8 @@ def main():
     with open(os.path.join(REPO, "tools", "tpu_probe_log.md"), "a") as f:
         f.write(f"- {ts} ladder3 probe: rc=0 {' '.join(parts)}\n")
     log(f"LADDER3 start: {' '.join(parts)}")
-    got_tpu_json = False
-    try:
-        got_tpu_json = stage_c_retry()
-    except subprocess.TimeoutExpired:
-        log("C': bench scale=18 TIMEOUT (3000s)")
+    # stage_c_retry handles its own per-scale timeouts.
+    got_tpu_json = stage_c_retry()
     try:
         subprocess.run([sys.executable,
                         os.path.join(REPO, "tools", "tpu_ladder2.py")],
