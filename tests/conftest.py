@@ -20,14 +20,34 @@ if "--xla_force_host_platform_device_count" not in _flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Late in a full-suite run, an XLA:CPU compile can segfault inside LLVM
-# (reproduced thrice at the same test when run after the whole suite; never
-# in isolation or with half-suite prefixes).  Primary mitigation is process
-# splitting (pytest.ini: -n 2).  Belt-and-braces: raise the stack soft
+# Stack headroom for XLA's compile worker threads: raise the stack soft
 # limit to a large FINITE value before jax loads — glibc sizes new pthread
 # stacks from the soft limit (RLIM_INFINITY would fall back to the 8 MiB
-# default), so XLA's compile worker threads get headroom too.
+# default).  (Historically suspected in the late-run segfault; the real
+# cause was the map-count limit above.)
 import resource  # noqa: E402
+
+# ROOT CAUSE of the single-process full-suite segfault (round 5,
+# tools/segfault_notes.md): XLA:CPU maps each compiled executable's code
+# into its own anonymous VMA (plus mprotect splits); a full-suite process
+# accumulates ~68k maps and crosses the kernel's vm.max_map_count default
+# of 65530, at which point mmap fails inside the executable loader (fresh
+# compile or persistent-cache AOT read alike) and it segfaults.  Measured:
+# peak 68,415 maps; the suite completes with the limit raised, crashes at
+# ~65k without.  Raise it best-effort (needs root — true in this image's
+# container; a no-op elsewhere keeps -n 3 as the fallback mitigation).
+# NOTE: this is a HOST-GLOBAL sysctl (no per-process form exists) and is
+# not restored on exit — intended for this image's dedicated container.
+# On a shared machine, opt out with CUVITE_NO_SYSCTL=1 and rely on -n 3.
+if not os.environ.get("CUVITE_NO_SYSCTL"):
+    try:
+        with open("/proc/sys/vm/max_map_count") as _f:
+            _maps_cur = int(_f.read())
+        if _maps_cur < 1 << 20:
+            with open("/proc/sys/vm/max_map_count", "w") as _f:
+                _f.write(str(1 << 20))
+    except (OSError, ValueError):
+        pass
 
 _s_soft, _s_hard = resource.getrlimit(resource.RLIMIT_STACK)
 _s_want = 512 << 20
@@ -44,11 +64,10 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 # Persistent XLA compile cache for the suite: a full-suite run compiles
-# hundreds of programs, and the cumulative LLVM state is what triggers
-# the late-run segfault above (the crash site is always inside an
-# XLA:CPU compile).  With the content-addressed disk cache, warm runs
-# skip LLVM for every previously seen program — removing both most of
-# the wall time and most of the crash exposure.
+# hundreds of programs; the content-addressed disk cache removes most of
+# that wall time on warm runs.  (It does NOT remove the map-count growth
+# — AOT loads map code pages just like fresh compiles — which is why the
+# max_map_count raise above is the actual segfault fix.)
 from cuvite_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()

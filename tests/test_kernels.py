@@ -161,6 +161,31 @@ def test_heavy_bincount_zero_weight_edges_are_candidates():
     assert np.array_equal(np.asarray(bg), np.asarray(ref.best_gain))
     assert np.array_equal(np.asarray(bc), np.asarray(ref.best_c))
 
+    # Constructed row where a community reached ONLY by a w=0 edge WINS:
+    # pins valid = (cnt > 0), not (wagg > 0) — the old rule returns
+    # community 2 here.  curr=0, no edges into it (eix=0); community 1
+    # via w=0 (tiny comm_deg -> positive gain), community 2 via w=0.5
+    # (huge comm_deg -> negative gain).
+    one = np.full((1, 128), nv_ceil, dtype=np.int32)
+    onew = np.zeros((1, 128), dtype=np.float32)
+    one[0, 0], onew[0, 0] = 1, 0.0
+    one[0, 1], onew[0, 1] = 2, 0.5
+    cd1 = np.ones(nv_ceil, dtype=np.float32)
+    cd1[1], cd1[2] = 0.125, 40.0
+    bc1, bg1, c01 = heavy_argmax_pallas(
+        jnp.asarray(one.T.copy()), jnp.asarray(onew.T.copy()),
+        jnp.asarray(cd1),
+        jnp.asarray(np.array([0], np.int32)),
+        jnp.asarray(np.array([0.5], np.float32)),
+        jnp.asarray(np.array([0.0], np.float32)),
+        jnp.asarray(np.array([0.5], np.float32)),  # ax = cd[0] - vdeg
+        jnp.asarray(np.float32(1 / 16)),
+        c_tile=c_tile, d_chunk=d_chunk, interpret=True,
+    )
+    assert int(bc1[0]) == 1, "w=0-only community must be the argmax"
+    assert float(bg1[0]) == 2 * 0.5 * (1 / 16) * (0.5 - 0.125)
+    assert float(c01[0]) == 0.0
+
 
 def test_heavy_bincount_padding_and_no_candidates():
     """Padded slots (c = nv_ceil, w = 0) never contribute; rows whose

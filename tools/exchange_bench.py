@@ -78,10 +78,14 @@ def main():
             out = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],
                 env=env, capture_output=True, text=True)
-            print(out.stdout.strip().splitlines()[-1]
-                  if out.stdout.strip() else
-                  f"scale={scale} exchange={exchange}: rc={out.returncode} "
-                  f"{(out.stderr or '')[-400:]}", flush=True)
+            if out.returncode != 0:
+                # A child that OOMs/crashes after printing its header must
+                # be LOUD, not reduced to its last stdout line.
+                print(f"scale={scale} exchange={exchange}: "
+                      f"rc={out.returncode} "
+                      f"{(out.stderr or '')[-400:]}", flush=True)
+            elif out.stdout.strip():
+                print(out.stdout.strip().splitlines()[-1], flush=True)
             for line in out.stdout.splitlines():
                 if line.startswith(f"scale={scale} exchange={exchange}"):
                     row[exchange] = float(line.split("wall=")[1].split("s")[0])
