@@ -70,16 +70,19 @@ def _kernel(const_ref, cT_ref, wT_ref, ayT_ref, curr_ref, vdeg_ref, sl_ref,
     neg_inf = jnp.full(curr.shape, -jnp.inf, dtype=wdt)
     bg0 = neg_inf
     bc0 = jnp.full(curr.shape, sentinel, dtype=c.dtype)
-    two_vdeg_const = 2.0 * vdeg * const
+    two_vdeg = 2.0 * vdeg
 
     def step_j(cj, ayj, eq, dup_j, bc, bg):
         """One candidate slot: aggregate duplicates, gain, running argmax.
         Shared by the unrolled (static j) and fori_loop (traced j) forms —
-        identical arithmetic, so the two are bit-identical."""
+        identical arithmetic, so the two are bit-identical.  Operand order
+        matches the XLA paths exactly (bucketed.py `_row_argmax`:
+        ((2*vdeg)*(ay-ax))*const) so engines agree bit-for-bit even on
+        non-dyadic constants where f32 association matters."""
         wagg_j = jnp.sum(jnp.where(eq, w, zero), axis=0, keepdims=True)
         valid_j = (~dup_j) & (cj != curr) if dup_j is not None \
             else (cj != curr)
-        gain_j = 2.0 * (wagg_j - eix) - two_vdeg_const * (ayj - ax)
+        gain_j = 2.0 * (wagg_j - eix) - two_vdeg * (ayj - ax) * const
         gain_j = jnp.where(valid_j, gain_j, neg_inf)
         better = gain_j > bg
         tie = valid_j & (gain_j == bg)

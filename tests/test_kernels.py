@@ -32,12 +32,17 @@ def _bucket_case(n_rows, width, nv, seed):
 
 @pytest.mark.parametrize("width", [8, 32, 64, 256])
 @pytest.mark.parametrize("seed", [0, 3])
-def test_row_argmax_pallas_matches_xla(width, seed):
+@pytest.mark.parametrize("constant", [None, np.float32(0.3)])
+def test_row_argmax_pallas_matches_xla(width, seed, constant):
     """Widths 8/32 exercise the unrolled candidate loop; 64/256 the
-    fori_loop form added for the wide classes (VERDICT r3 item 4)."""
+    fori_loop form added for the wide classes (VERDICT r3 item 4).
+    constant=0.3 (non-dyadic) pins the gain's operand ASSOCIATION to the
+    XLA path's — with the default dyadic 1/64 every association is exact
+    and a reassociation regression would be invisible."""
     n_rows, nv = 256, 500
-    cmat, wmat, curr, vdeg, sl, comm_deg, constant = _bucket_case(
+    cmat, wmat, curr, vdeg, sl, comm_deg, _const_dyadic = _bucket_case(
         n_rows, width, nv, seed)
+    constant = _const_dyadic if constant is None else constant
 
     # Reference path mirrors bucketed_step: both kernels take the self-loop
     # weight and derive eix = counter0 - sl row-locally.
@@ -89,17 +94,21 @@ def test_row_argmax_pallas_no_candidates():
 
 
 @pytest.mark.parametrize("seed", [0, 5])
-def test_heavy_bincount_matches_quadratic_oracle(seed):
+@pytest.mark.parametrize("constant", [None, np.float32(0.3)])
+def test_heavy_bincount_matches_quadratic_oracle(seed, constant):
     """Heavy-class community-range-tile kernel (heavy_bincount.py) vs the
     quadratic XLA fallback on the same rows: identical best_c/best_gain/
     counter0 bit-for-bit (1/16-multiple weights make f32 sums exact in any
-    order, so the matmul-bincount and the all-pairs aggregation agree)."""
+    order, so the matmul-bincount and the all-pairs aggregation agree;
+    the non-dyadic constant=0.3 case additionally pins the gain's operand
+    association to the XLA path's)."""
     from cuvite_tpu.kernels.heavy_bincount import heavy_argmax_pallas
 
     n_rows, width, nv = 64, 512, 500
     nv_ceil, c_tile, d_chunk = 512, 128, 128
-    cmat, wmat, curr, vdeg, sl, comm_deg, constant = _bucket_case(
+    cmat, wmat, curr, vdeg, sl, comm_deg, _const_dyadic = _bucket_case(
         n_rows, width, nv, seed)
+    constant = _const_dyadic if constant is None else constant
     is_cc = cmat == curr[:, None]
     counter0 = np.sum(np.where(is_cc, wmat, 0.0), axis=1).astype(np.float32)
     ay = comm_deg[cmat]
