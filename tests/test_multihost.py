@@ -212,7 +212,11 @@ def test_four_process_dist_ingest_rmat15(tmp_path):
                    for i in range(nproc))
 
     procs, outs = launch()
-    for _retry in range(2):
+    # Up to 3 retries: a cold compile cache makes the first attempts
+    # slow enough on this 1-core host that the coordination service's
+    # fixed ~30s shutdown barrier expires while two workers still
+    # compile; each retry runs warmer (the persistent cache fills).
+    for _retry in range(3):
         if results_complete() or not any(
                 "DEADLINE_EXCEEDED" in o for o in outs):
             break
@@ -220,10 +224,10 @@ def test_four_process_dist_ingest_rmat15(tmp_path):
         # barrier have fixed ~30 s deadlines with no knob; on this
         # 1-core host a full-suite run (other xdist workers compiling)
         # can starve one of the 4 processes past them.  Scheduler
-        # artifact, not a correctness signal — retry (at most twice)
-        # on the specific signature, after letting the compile burst
-        # pass.  A genuine failure (assertion, crash) does not match
-        # and still fails below.
+        # artifact, not a correctness signal — retry (bounded by the
+        # loop above) on the specific signature, after letting the
+        # compile burst pass.  A genuine failure (assertion, crash)
+        # does not match and still fails below.
         time.sleep(45)
         for i in range(nproc):
             (tmp_path / f"dv4comm.{i}.npy").unlink(missing_ok=True)
