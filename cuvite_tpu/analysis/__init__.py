@@ -1,0 +1,47 @@
+"""graftlint — AST-based static analysis for the cuvite_tpu codebase.
+
+The correctness properties this repo depends on are mostly *not* testable
+at unit-test cost: every host must issue the same collectives in the same
+order (the multi-host analogue of the reference's lock-step MPI
+exchanges), hot device paths must not silently fall back to host syncs or
+64-bit dtypes, and reductions feeding modularity must stay deterministic
+— the class of hazards that made synchronised/parallel Louvain variants
+diverge from sequential quality (arXiv:1702.04645, arXiv:1805.10904).
+graftlint encodes them as lint rules so every future PR is checked at
+AST-walk cost instead of multi-host reproduction cost.
+
+Layout:
+  engine.py   — source loading, rule registry, suppressions, baseline
+  rules.py    — the shipped rule set (R001..R008)
+  __main__.py — CLI: python -m cuvite_tpu.analysis [paths] [options]
+
+See ANALYSIS.md at the repo root for the rule catalogue, suppression
+syntax (``# graftlint: disable=R001``) and the baseline workflow.
+"""
+
+from cuvite_tpu.analysis.engine import (
+    Finding,
+    Rule,
+    SEVERITIES,
+    all_rules,
+    apply_baseline,
+    load_baseline,
+    run_paths,
+    run_source,
+    write_baseline,
+)
+
+# Importing the rules module populates the registry as a side effect.
+from cuvite_tpu.analysis import rules as _rules  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "Rule",
+    "SEVERITIES",
+    "all_rules",
+    "apply_baseline",
+    "load_baseline",
+    "run_paths",
+    "run_source",
+    "write_baseline",
+]

@@ -1,0 +1,109 @@
+"""graftlint CLI.
+
+Usage:
+    python -m cuvite_tpu.analysis [paths...] [--format text|json]
+        [--baseline FILE] [--write-baseline] [--fail-on high|medium|low]
+        [--list-rules]
+
+Exit status: 0 when no NON-BASELINED finding at or above the gate
+severity (default: high) remains; 1 otherwise; 2 on usage errors.
+The repo's canonical invocation (what tests/test_analysis.py and
+tools/lint.sh run) is:
+
+    python -m cuvite_tpu.analysis cuvite_tpu tools tests \
+        --baseline tools/graftlint_baseline.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from cuvite_tpu.analysis.engine import (
+    SEVERITIES,
+    all_rules,
+    apply_baseline,
+    gate_failures,
+    load_baseline,
+    run_paths,
+    write_baseline,
+)
+from cuvite_tpu.analysis import rules as _rules  # noqa: F401 (registry)
+
+DEFAULT_PATHS = ["cuvite_tpu", "tools", "tests"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cuvite_tpu.analysis",
+        description="graftlint: TPU/JAX static analysis for cuvite_tpu")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help=f"files/directories to lint (default: "
+                         f"{' '.join(DEFAULT_PATHS)})")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", metavar="FILE", default=None,
+                    help="JSON baseline of grandfathered findings")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write ALL current findings to --baseline and "
+                         "exit 0 (requires --baseline)")
+    ap.add_argument("--fail-on", choices=SEVERITIES, default="high",
+                    help="lowest severity that fails the gate "
+                         "(default: high)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  [{rule.severity:6s}] {rule.title}")
+        return 0
+
+    paths = args.paths or DEFAULT_PATHS
+    findings = run_paths(paths)
+
+    if args.write_baseline:
+        if not args.baseline:
+            ap.error("--write-baseline requires --baseline FILE")
+        write_baseline(args.baseline, findings)
+        errors = [f for f in findings if f.rule == "E000"]
+        print(f"wrote {len(findings) - len(errors)} finding(s) to "
+              f"{args.baseline}")
+        if errors:
+            # E000 is never baselineable (engine.write_baseline drops
+            # it); pretending the rebaseline captured it would surprise
+            # the operator on the very next gated run.
+            for f in errors:
+                print(f.format())
+            print(f"graftlint: {len(errors)} unprocessable input(s) NOT "
+                  "baselined; E000 always fails the gate")
+            return 1
+        return 0
+
+    baseline = load_baseline(args.baseline) if args.baseline else {}
+    new, grandfathered = apply_baseline(findings, baseline)
+    failures = gate_failures(new, args.fail_on)
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.to_dict() for f in new],
+            "baselined": len(grandfathered),
+            "gate": {"fail_on": args.fail_on,
+                     "failures": len(failures)},
+        }, indent=2))
+    else:
+        for f in new:
+            print(f.format())
+        counts = {}
+        for f in new:
+            counts[f.severity] = counts.get(f.severity, 0) + 1
+        summary = ", ".join(f"{counts[s]} {s}" for s in SEVERITIES
+                            if s in counts) or "0"
+        print(f"graftlint: {len(new)} finding(s) ({summary}); "
+              f"{len(grandfathered)} baselined; "
+              f"gate fail-on={args.fail_on}: "
+              f"{'FAIL' if failures else 'ok'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

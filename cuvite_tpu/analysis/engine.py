@@ -1,0 +1,585 @@
+"""graftlint engine: source model, rule registry, suppressions, baseline.
+
+The engine is deliberately self-contained (stdlib ``ast`` only — no jax
+import, no third-party dependency) so it can run in any environment the
+repo runs in, including bare CI containers, in well under a second for
+the whole tree.
+
+Per-file model (``SourceFile``)
+-------------------------------
+Each analysed file is parsed once and annotated with the facts every
+rule needs:
+
+  * a parent map (``ast`` has no parent pointers), so rules can walk
+    *up* from a call site through its enclosing ``if``/``try`` blocks;
+  * the function table: every ``def`` (nested included) with its
+    parameters, decorators, and module-local call edges;
+  * jit roots: functions decorated with ``@jax.jit`` (bare or via
+    ``functools.partial``) or wrapped at a call site (``jax.jit(f)`` /
+    ``jax.jit(f, static_argnames=...)``), with their static argument
+    names resolved from ``static_argnums``/``static_argnames``;
+  * the jit-*reachable* closure: jit roots plus every same-module
+    function transitively called from one.  Cross-module reachability is
+    intentionally out of scope — name-based linking across imports would
+    trade a bounded false-negative rate for an unbounded false-positive
+    rate (see ANALYSIS.md, "Scope & limits").
+
+Suppressions
+------------
+``# graftlint: disable=R001`` (comma-separated ids, or ``all``) on the
+flagged line suppresses findings on that line only.
+``# graftlint: disable-file=R003`` within the first ``FILE_PRAGMA_LINES``
+lines suppresses a rule for the whole file.
+
+Baseline
+--------
+A checked-in JSON file grandfathers pre-existing findings so the gate
+only bites on *new* ones.  Entries are matched as a multiset of
+``(path, rule, stripped-source-line)`` fingerprints — line *numbers* are
+deliberately excluded so unrelated edits above a grandfathered finding
+do not invalidate the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable, Iterator
+
+SEVERITIES = ("high", "medium", "low")
+
+FILE_PRAGMA_LINES = 20
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_FILE_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable-file=([A-Za-z0-9_,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one source location."""
+
+    rule: str
+    severity: str
+    path: str
+    line: int
+    message: str
+    snippet: str  # stripped source line: the baseline fingerprint
+
+    def fingerprint(self) -> tuple:
+        return (self.path, self.rule, self.snippet)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} "
+                f"[{self.severity}] {self.message}")
+
+
+class Rule:
+    """Base class for graftlint rules.
+
+    Subclasses set ``id`` (``R###``), ``severity`` (one of SEVERITIES),
+    ``title``, and implement ``check`` yielding raw findings — the
+    engine applies suppressions and the baseline afterwards.
+    """
+
+    id: str = ""
+    severity: str = "medium"
+    title: str = ""
+
+    def check(self, sf: "SourceFile") -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, sf: "SourceFile", node: ast.AST,
+                message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(rule=self.id, severity=self.severity, path=sf.rel,
+                       line=line, message=message, snippet=sf.line(line))
+
+
+_REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls):
+    """Class decorator adding a rule (one shared instance) to the
+    registry; idempotent per id so test re-imports don't duplicate."""
+    inst = cls()
+    if not inst.id or inst.severity not in SEVERITIES:
+        raise ValueError(f"rule {cls.__name__}: bad id/severity")
+    _REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_rules() -> list:
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+def dotted(node: ast.AST) -> str | None:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _const_names(node: ast.AST) -> list | None:
+    """String constant or tuple/list of string constants -> list of str."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                return None
+            out.append(el.value)
+        return out
+    return None
+
+
+def _const_ints(node: ast.AST) -> list | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, int)):
+                return None
+            out.append(el.value)
+        return out
+    return None
+
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+
+def _jit_call(node: ast.AST) -> ast.Call | None:
+    """The Call node if ``node`` is ``jax.jit(...)`` or
+    ``functools.partial(jax.jit, ...)``; else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = dotted(node.func)
+    if name in _JIT_NAMES:
+        return node
+    if name in _PARTIAL_NAMES and node.args \
+            and dotted(node.args[0]) in _JIT_NAMES:
+        return node
+    return None
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    name: str
+    node: ast.AST                      # FunctionDef / AsyncFunctionDef
+    params: list                       # positional+kw-only param names
+    is_jit: bool = False               # decorated / wrapped with jax.jit
+    static_names: set = dataclasses.field(default_factory=set)
+    calls: set = dataclasses.field(default_factory=set)  # local callee names
+    jit_reachable: bool = False
+
+
+def _params_of(node) -> list:
+    a = node.args
+    names = [p.arg for p in a.posonlyargs + a.args]
+    kwonly = [p.arg for p in a.kwonlyargs]
+    return names + kwonly
+
+
+def _statics_from_jit_call(call: ast.Call, params: list) -> set:
+    """Resolve static_argnums/static_argnames keywords of a jit (or
+    partial-of-jit) call against a parameter list."""
+    statics: set = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            names = _const_names(kw.value)
+            if names:
+                statics.update(names)
+        elif kw.arg == "static_argnums":
+            nums = _const_ints(kw.value)
+            if nums:
+                pos = [p for p in params]
+                for i in nums:
+                    if 0 <= i < len(pos):
+                        statics.add(pos[i])
+    return statics
+
+
+class _Builder(ast.NodeVisitor):
+    """Single pass collecting parents, the function table, per-function
+    call edges, and call-site jit wraps (``jax.jit(f)``)."""
+
+    def __init__(self, sf: "SourceFile"):
+        self.sf = sf
+        self.stack: list[FunctionInfo] = []
+
+    def generic_visit(self, node):
+        for child in ast.iter_child_nodes(node):
+            self.sf.parent_map[child] = node
+            self.visit(child)
+
+    def _visit_funcdef(self, node):
+        info = FunctionInfo(name=node.name, node=node,
+                            params=_params_of(node))
+        for dec in node.decorator_list:
+            if dotted(dec) in _JIT_NAMES:
+                info.is_jit = True
+            else:
+                call = _jit_call(dec)
+                if call is not None:
+                    info.is_jit = True
+                    info.static_names |= _statics_from_jit_call(
+                        call, info.params)
+        self.sf.functions.append(info)
+        self.sf.func_by_name[node.name].append(info)
+        self.sf.func_of_node[node] = info
+        self.stack.append(info)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_funcdef
+    visit_AsyncFunctionDef = _visit_funcdef
+
+    def visit_Call(self, node):
+        if self.stack:
+            if isinstance(node.func, ast.Name):
+                self.stack[-1].calls.add(node.func.id)
+            elif isinstance(node.func, ast.Attribute):
+                self.stack[-1].calls.add(node.func.attr)
+        # Call-site wrap: jax.jit(f[, static_argnames=...]) marks local f
+        # as a jit root (the `return jax.jit(step)` factory idiom).
+        if dotted(node.func) in _JIT_NAMES and node.args:
+            target = node.args[0]
+            tname = target.id if isinstance(target, ast.Name) else None
+            if tname:
+                self.sf.jit_wrapped[tname] = node
+        self.generic_visit(node)
+
+
+class SourceFile:
+    """Parsed + annotated source file (see module docstring)."""
+
+    def __init__(self, text: str, path: str = "<string>",
+                 rel: str | None = None):
+        self.path = path
+        self.rel = rel if rel is not None else path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.parent_map: dict = {}
+        self.functions: list[FunctionInfo] = []
+        self.func_by_name: dict = collections.defaultdict(list)
+        self.func_of_node: dict = {}
+        self.jit_wrapped: dict = {}
+        _Builder(self).visit(self.tree)
+        self._apply_jit_wraps()
+        self._propagate_reachability()
+        self._line_suppress, self._file_suppress = self._parse_suppressions()
+
+    # -- construction helpers ------------------------------------------
+
+    def _apply_jit_wraps(self):
+        for name, call in self.jit_wrapped.items():
+            infos = self.func_by_name.get(name, ())
+            for info in infos:
+                info.is_jit = True
+                # Statics only attach when the name is unambiguous: with
+                # several same-named factory-locals (bucketed.py defines
+                # 'step' three times) the wrap cannot be attributed, and
+                # wrongly marking a traced param static would silently
+                # blind R002's traced-branch check for the others.
+                if len(infos) == 1:
+                    info.static_names |= _statics_from_jit_call(
+                        call, info.params)
+
+    def _propagate_reachability(self):
+        queue = [f for f in self.functions if f.is_jit]
+        for f in queue:
+            f.jit_reachable = True
+        while queue:
+            f = queue.pop()
+            for callee in f.calls:
+                for g in self.func_by_name.get(callee, ()):
+                    if not g.jit_reachable:
+                        g.jit_reachable = True
+                        queue.append(g)
+
+    def _parse_suppressions(self):
+        """Pragmas are read from real COMMENT tokens, not raw line text:
+        a docstring QUOTING the suppression syntax (ANALYSIS.md does!)
+        must not silently disable rules for the file containing it."""
+        line_sup: dict = {}
+        file_sup: set = set()
+        for lineno, comment in self._iter_comments():
+            if "graftlint" not in comment:
+                continue
+            m = _SUPPRESS_RE.search(comment)
+            if m:
+                ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+                line_sup.setdefault(lineno, set()).update(ids)
+            m = _FILE_SUPPRESS_RE.search(comment)
+            if m and lineno <= FILE_PRAGMA_LINES:
+                file_sup |= {s.strip() for s in m.group(1).split(",")
+                             if s.strip()}
+        return line_sup, file_sup
+
+    def _iter_comments(self):
+        """(lineno, text) of every comment token.  Falls back to a raw
+        line scan if tokenize rejects what ast accepted (not expected —
+        but losing suppressions wholesale would flip every suppressed
+        intentional finding back into a gate failure)."""
+        import io
+        import tokenize
+
+        try:
+            toks = list(tokenize.generate_tokens(
+                io.StringIO(self.text).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return [(i, raw) for i, raw in enumerate(self.lines, start=1)
+                    if "#" in raw]
+        return [(t.start[0], t.string) for t in toks
+                if t.type == tokenize.COMMENT]
+
+    # -- rule-facing API -----------------------------------------------
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, lineno: int, rule_id: str) -> bool:
+        if rule_id in self._file_suppress or "all" in self._file_suppress:
+            return True
+        ids = self._line_suppress.get(lineno, ())
+        return rule_id in ids or "all" in ids
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self.parent_map.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parent_map.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parent_map.get(cur)
+
+    def enclosing_function(self, node: ast.AST) -> FunctionInfo | None:
+        for anc in self.ancestors(node):
+            info = self.func_of_node.get(anc)
+            if info is not None:
+                return info
+        return None
+
+    def walk(self):
+        return ast.walk(self.tree)
+
+
+# ---------------------------------------------------------------------------
+# Running
+
+
+def _severity_rank(sev: str) -> int:
+    return SEVERITIES.index(sev)
+
+
+def run_source(text: str, path: str = "<string>", rules=None,
+               rel: str | None = None) -> list:
+    """Lint one source string; returns suppression-filtered findings.
+
+    The unit-test entry point: rules see exactly what they would see for
+    a real file at ``rel``/``path``.
+    """
+    if rules is None:
+        rules = all_rules()
+    sf = SourceFile(text, path=path, rel=rel)
+    out = []
+    for rule in rules:
+        for f in rule.check(sf):
+            if not sf.suppressed(f.line, f.rule):
+                out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[str]:
+    """All .py files under the given files/directories, sorted, deduped."""
+    seen = set()
+    for p in paths:
+        if os.path.isfile(p):
+            # An explicit non-.py argument is not linted as Python: the
+            # caller gets the 'no Python files' E000 from run_paths
+            # instead of a bogus syntax-error finding on a shell script.
+            files = [p] if p.endswith(".py") else []
+        else:
+            files = []
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                files.extend(os.path.join(root, n) for n in sorted(names)
+                             if n.endswith(".py"))
+        for f in files:
+            key = os.path.abspath(f)
+            if key not in seen:
+                seen.add(key)
+                yield f
+
+
+# Path-scoped rules (R003/R006/R007/R008) and baseline fingerprints key
+# on repo-root-relative paths, so rel must be anchored to the REPO ROOT,
+# not the CWD — otherwise linting from one directory up would rewrite
+# every rel to 'repo/tools/...', silently disabling the scoped rules and
+# unmatching the whole baseline while still printing 'ok'.
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _relpath(path: str, anchor: str | None = None) -> str:
+    """Repo-root-relative when inside the repo; else relative to
+    ``anchor`` (the parent of the scan-root argument, so an external
+    '<tree>/tools' exercises the tools/-scoped rules REGARDLESS of the
+    CWD — the anchor must outrank the CWD, or linting that tree from an
+    ancestor directory would resolve 'rpt/ext/tools/...' and silently
+    skip every scoped rule); CWD-relative as the last resort."""
+    ap = os.path.abspath(path)
+    for base in (_REPO_ROOT, anchor, os.getcwd()):
+        if base is None:
+            continue
+        rel = os.path.relpath(ap, base)
+        if not rel.startswith(".."):
+            return rel.replace(os.sep, "/")
+    return ap.replace(os.sep, "/")
+
+
+def run_paths(paths: Iterable[str], rules=None) -> list:
+    """Lint every .py file under ``paths``.  Failure is CLOSED on both
+    bad inputs: an unparsable file yields a high-severity E000 finding
+    instead of aborting the run, and an input path with no Python files
+    under it (typo, renamed directory) yields one too — otherwise a
+    stale CI invocation would print 'ok' forever while linting
+    nothing."""
+    if rules is None:
+        rules = all_rules()
+    findings = []
+    files = []
+    for p in paths:
+        batch = list(iter_py_files([p]))
+        if not batch:
+            findings.append(Finding(
+                rule="E000", severity="high", path=str(p), line=1,
+                message="path contains no Python files (missing or "
+                        "renamed? the gate would silently pass)",
+                snippet=""))
+        # Anchor = parent of the SCAN ROOT: for a file argument that is
+        # the file's grandparent dir, so 'lint /ext/tools/bench.py' and
+        # 'lint /ext/tools' both resolve rel='tools/bench.py' and hit
+        # the same scoped rules.
+        anchor = os.path.dirname(os.path.abspath(p))
+        if os.path.isfile(p):
+            anchor = os.path.dirname(anchor)
+        files.extend((f, anchor) for f in batch)
+    seen = set()
+    for fpath, anchor in files:
+        if os.path.abspath(fpath) in seen:
+            continue
+        seen.add(os.path.abspath(fpath))
+        rel = _relpath(fpath, anchor)
+        try:
+            with open(fpath, encoding="utf-8") as fh:
+                text = fh.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding(
+                rule="E000", severity="high", path=rel, line=1,
+                message=f"cannot read file: {e}", snippet=""))
+            continue
+        try:
+            findings.extend(run_source(text, path=fpath, rules=rules,
+                                       rel=rel))
+        except SyntaxError as e:
+            findings.append(Finding(
+                rule="E000", severity="high", path=rel,
+                line=e.lineno or 1,
+                message=f"syntax error: {e.msg}", snippet=""))
+        except ValueError as e:
+            # e.g. ast.parse on a null byte: not a SyntaxError, but the
+            # same fail-closed answer
+            findings.append(Finding(
+                rule="E000", severity="high", path=rel, line=1,
+                message=f"unparsable source: {e}", snippet=""))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> collections.Counter:
+    """Baseline file -> Counter of (path, rule, snippet) fingerprints.
+    A missing file is an empty baseline (first-run ergonomics)."""
+    if not os.path.exists(path):
+        return collections.Counter()
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path!r}: unsupported version {data.get('version')!r}")
+    counter: collections.Counter = collections.Counter()
+    for ent in data.get("findings", []):
+        key = (ent["path"], ent["rule"], ent["snippet"])
+        counter[key] += int(ent.get("count", 1))
+    return counter
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    # E000 (unreadable/unparsable file) is deliberately NOT baselineable:
+    # its fingerprint carries no snippet, so one grandfathered parse
+    # error would match every FUTURE parse error of that path — i.e.
+    # permanently un-lint the file.  Infrastructure errors must always
+    # fail the gate.
+    counter: collections.Counter = collections.Counter(
+        f.fingerprint() for f in findings if f.rule != "E000")
+    ents = [
+        {"path": p, "rule": r, "snippet": s, "count": c}
+        for (p, r, s), c in sorted(counter.items())
+    ]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": BASELINE_VERSION, "findings": ents}, fh,
+                  indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def apply_baseline(findings: list, baseline: collections.Counter):
+    """Split findings into (new, grandfathered) against the baseline
+    multiset.  Duplicate fingerprints consume baseline slots in source
+    order, so N baselined copies admit exactly N occurrences."""
+    budget = collections.Counter(baseline)
+    new, old = [], []
+    for f in findings:
+        key = f.fingerprint()
+        # E000 never matches the baseline, even a hand-edited one — see
+        # write_baseline.
+        if f.rule != "E000" and budget[key] > 0:
+            budget[key] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+def gate_failures(findings: list, min_severity: str = "high") -> list:
+    """The findings that fail the gate: severity at or above
+    ``min_severity`` (after baseline filtering by the caller)."""
+    cut = _severity_rank(min_severity)
+    return [f for f in findings if _severity_rank(f.severity) <= cut]

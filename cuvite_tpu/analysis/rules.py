@@ -1,0 +1,595 @@
+"""graftlint rule set R001..R008 (see ANALYSIS.md for the catalogue).
+
+Each rule targets a hazard class this codebase has actually hit (or is
+one refactor away from hitting): host syncs inside jitted code, jit
+recompile traps, 64-bit dtype drift into the 32-bit device path,
+collective-order divergence across hosts, mutation of caller-owned
+buffers, non-exact reductions feeding modularity, unbounded child
+processes in tools, and host-global side effects in test fixtures.
+
+Rules are heuristic by design: they trade completeness for a near-zero
+false-positive rate on idiomatic code, and every remaining intentional
+violation is handled by an inline ``# graftlint: disable=R###`` with a
+justification comment, or by the checked-in baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from cuvite_tpu.analysis.engine import Rule, dotted, register
+
+# Directories whose modules run (or build arrays for) the device path.
+DEVICE_PATH_PREFIXES = (
+    "cuvite_tpu/louvain/",
+    "cuvite_tpu/kernels/",
+    "cuvite_tpu/ops/",
+)
+
+# Host-blocking calls that must not appear in jit-reachable code: each
+# one forces a device->host transfer (or a trace-time concretization
+# error that only fires on the first run of a rarely-taken path).
+HOST_SYNC_ATTRS = {"item", "block_until_ready", "tolist"}
+HOST_SYNC_CALLS = {
+    "float", "int", "bool",
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "jax.device_get",
+}
+
+# Host-side collective wrappers (cuvite_tpu.comm.multihost) plus the jax
+# primitives they wrap: every host must reach these in the same order.
+COLLECTIVE_NAMES = {
+    "process_allgather", "allgather_varlen", "allreduce_sum_host",
+    "allreduce_max_host", "gather_global", "broadcast_one_to_all",
+    "sync_global_devices", "broadcast_host_local_array",
+}
+
+# Condition calls that are uniform across hosts by construction, so
+# branching on them cannot diverge collective order.
+UNIFORM_CONDITION_CALLS = {
+    "is_distributed", "len", "isinstance", "issubclass", "bool", "int",
+    "jax.process_count", "process_count", "hasattr",
+}
+
+
+def _in_device_path(sf) -> bool:
+    return sf.rel.startswith(DEVICE_PATH_PREFIXES)
+
+
+def _nodes_of_function(sf, info):
+    """Nodes lexically inside ``info``'s body but not inside a nested
+    def (those belong to the nested function)."""
+    for node in ast.walk(info.node):
+        if node is not info.node and sf.enclosing_function(node) is info:
+            yield node
+
+
+@register
+class HostSyncInJit(Rule):
+    id = "R001"
+    severity = "high"
+    title = "host-sync call reachable from a @jax.jit function"
+
+    def check(self, sf):
+        for node in sf.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            info = sf.enclosing_function(node)
+            if info is None or not info.jit_reachable:
+                continue
+            name = dotted(node.func)
+            label = None
+            if name in HOST_SYNC_CALLS:
+                label = f"{name}()"
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in HOST_SYNC_ATTRS \
+                    and not node.args:
+                label = f".{node.func.attr}()"
+            if label is None:
+                continue
+            yield self.finding(
+                sf, node,
+                f"{label} in '{info.name}' (reachable from @jax.jit): "
+                "forces a blocking device->host sync, or a trace-time "
+                "concretization error on the first traced run")
+
+
+def _is_none_check(test: ast.expr) -> bool:
+    """``<expr> is None`` / ``is not None`` — trace-time structural
+    dispatch (an operand is either a tracer or literally None), never a
+    branch on traced VALUES, so R002 exempts it wholesale."""
+    return (isinstance(test, ast.Compare)
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], (ast.Is, ast.IsNot))
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None)
+
+
+@register
+class RecompileTrap(Rule):
+    id = "R002"
+    severity = "medium"
+    title = "jit recompile trap (non-literal statics / traced branching)"
+
+    def _check_statics(self, sf):
+        from cuvite_tpu.analysis.engine import (
+            _const_ints, _const_names, _jit_call,
+        )
+
+        for node in sf.walk():
+            call = _jit_call(node)
+            if call is None:
+                continue
+            for kw in call.keywords:
+                if kw.arg == "static_argnames":
+                    ok = _const_names(kw.value) is not None \
+                        or isinstance(kw.value, ast.Name)
+                    what = "static_argnames"
+                elif kw.arg == "static_argnums":
+                    ok = _const_ints(kw.value) is not None \
+                        or isinstance(kw.value, ast.Name)
+                    what = "static_argnums"
+                else:
+                    continue
+                if not ok:
+                    yield self.finding(
+                        sf, kw.value,
+                        f"{what} is not a literal int/str (tuple): "
+                        "computed statics hide unhashable or array "
+                        "values, which either crash dispatch or key the "
+                        "compile cache on object identity (a recompile "
+                        "per call)")
+
+    def _check_branches(self, sf):
+        for info in sf.functions:
+            if not info.is_jit:
+                continue
+            traced = set(info.params) - info.static_names
+            if not traced:
+                continue
+            for node in _nodes_of_function(sf, info):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                if _is_none_check(node.test):
+                    continue
+                names = {n.id for n in ast.walk(node.test)
+                         if isinstance(n, ast.Name)}
+                hot = sorted(names & traced)
+                if hot:
+                    yield self.finding(
+                        sf, node,
+                        f"Python branch on traced argument(s) "
+                        f"{', '.join(hot)} of jitted '{info.name}': "
+                        "concretizes the tracer (TracerBoolConversionError"
+                        " at best, silent per-value recompiles via "
+                        "static fallback at worst); use lax.cond/select "
+                        "or mark the argument static")
+
+    def check(self, sf):
+        yield from self._check_statics(sf)
+        yield from self._check_branches(sf)
+
+
+_J64_ATTRS = {"jnp.int64", "jnp.float64", "jnp.uint64",
+              "jax.numpy.int64", "jax.numpy.float64", "jax.numpy.uint64"}
+_J64_NP_ATTRS = {"np.int64", "np.float64", "np.uint64",
+                 "numpy.int64", "numpy.float64", "numpy.uint64"}
+_J64_STRINGS = {"int64", "float64", "uint64"}
+_JNP_PREFIXES = ("jnp.", "jax.numpy.")
+
+
+def _is_64_dtype_arg(node: ast.AST) -> str | None:
+    """'int64'-style label if ``node`` denotes a 64-bit dtype (string
+    constant or np/numpy attribute; jnp attributes are reported by the
+    attribute branch already), else None."""
+    if isinstance(node, ast.Constant) and node.value in _J64_STRINGS:
+        return str(node.value)
+    name = dotted(node)
+    if name in _J64_NP_ATTRS:
+        return name
+    return None
+
+
+@register
+class DtypeWidthDrift(Rule):
+    id = "R003"
+    severity = "medium"
+    title = "64-bit device dtype in a 32-bit device-path module"
+
+    def check(self, sf):
+        if not _in_device_path(sf):
+            return
+        for node in sf.walk():
+            if isinstance(node, ast.Attribute) and dotted(node) in _J64_ATTRS:
+                yield self.finding(
+                    sf, node,
+                    f"{dotted(node)} in a device-path module: without "
+                    "jax_enable_x64 this silently degrades to 32-bit "
+                    "(corrupting packed keys / ids), and with it the "
+                    "whole graph pays 2x memory; route widths through "
+                    "the dtype policy (core.types) instead")
+            elif isinstance(node, ast.Call):
+                fname = dotted(node.func) or ""
+                if fname.startswith(_JNP_PREFIXES):
+                    for kw in node.keywords:
+                        label = kw.arg == "dtype" \
+                            and _is_64_dtype_arg(kw.value)
+                        if label:
+                            yield self.finding(
+                                sf, kw.value,
+                                f"dtype={label} passed to {fname} in a "
+                                "device-path module: defeats the 32-bit "
+                                "graph mode (see R003 notes in "
+                                "ANALYSIS.md)")
+                # .astype(<64-bit>) where the receiver is itself a jnp
+                # construction — host np arrays cast with .astype(np.int64)
+                # are plan-building code and stay out of scope.
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "astype" and node.args:
+                    recv = node.func.value
+                    rname = dotted(recv.func) \
+                        if isinstance(recv, ast.Call) else dotted(recv)
+                    label = _is_64_dtype_arg(node.args[0])
+                    if label and rname and rname.startswith(_JNP_PREFIXES):
+                        yield self.finding(
+                            sf, node,
+                            f".astype({label}) on a {rname} result in a "
+                            "device-path module: defeats the 32-bit "
+                            "graph mode (see R003 notes in ANALYSIS.md)")
+
+
+def _condition_is_divergent(test: ast.expr) -> str | None:
+    """Why a branch condition can differ between hosts, or None.
+
+    Divergent: references process_index / process_id, or contains any
+    call other than the known host-uniform predicates (a call result is
+    runtime data the linter cannot prove replicated)."""
+    for n in ast.walk(test):
+        name = dotted(n) if isinstance(n, (ast.Name, ast.Attribute)) else None
+        if name and name.split(".")[-1] in ("process_index", "process_id"):
+            return f"condition references {name}"
+        if isinstance(n, ast.Call):
+            cname = dotted(n.func) or "<expr>"
+            if cname.split(".")[-1] not in UNIFORM_CONDITION_CALLS \
+                    and cname not in UNIFORM_CONDITION_CALLS:
+                return f"condition depends on {cname}(...)"
+    return None
+
+
+@register
+class CollectiveOrderDivergence(Rule):
+    id = "R004"
+    severity = "high"
+    title = "collective call under a data-dependent or fallible branch"
+
+    def check(self, sf):
+        for node in sf.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func) or ""
+            if fname.split(".")[-1] not in COLLECTIVE_NAMES:
+                continue
+            info = sf.enclosing_function(node)
+            boundary = info.node if info is not None else None
+            child = node
+            for anc in sf.ancestors(node):
+                if anc is boundary:
+                    break
+                if isinstance(anc, ast.Try):
+                    yield self.finding(
+                        sf, node,
+                        f"collective {fname}() inside a try block: an "
+                        "exception on one host skips its remaining "
+                        "collectives while peers block in them — "
+                        "deadlock, not an error message; hoist the "
+                        "collective out or convert the failure into a "
+                        "value every host agrees on")
+                    break
+                if isinstance(anc, (ast.If, ast.While)) \
+                        and child is not anc.test:
+                    why = _condition_is_divergent(anc.test)
+                    if why:
+                        yield self.finding(
+                            sf, node,
+                            f"collective {fname}() under a branch that "
+                            f"may differ between hosts ({why}): hosts "
+                            "disagreeing on whether to issue a "
+                            "collective is the canonical multi-host "
+                            "deadlock; make the condition a replicated "
+                            "value or issue the collective "
+                            "unconditionally")
+                        break
+                child = anc
+
+
+_INPLACE_METHODS = {"fill", "sort", "resize", "partition", "put", "setfield"}
+
+
+@register
+class CallerBufferMutation(Rule):
+    id = "R005"
+    severity = "medium"
+    title = "mutation of a caller-owned buffer argument"
+
+    def check(self, sf):
+        for info in sf.functions:
+            # Pallas kernels receive mutable Refs — writing *_ref output
+            # params is their calling convention, not a hazard.
+            params = {p for p in info.params
+                      if p not in ("self", "cls")
+                      and not p.endswith("_ref")}
+            if not params:
+                continue
+            for node in _nodes_of_function(sf, info):
+                yield from self._check_node(sf, info, params, node)
+
+    def _check_node(self, sf, info, params, node):
+        def is_param(expr):
+            return isinstance(expr, ast.Name) and expr.id in params
+
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                # p.flags.writeable = ... — the caller's array changes
+                # behaviour (later writes raise) as a side effect.
+                if isinstance(tgt, ast.Attribute) \
+                        and tgt.attr == "writeable" \
+                        and isinstance(tgt.value, ast.Attribute) \
+                        and tgt.value.attr == "flags" \
+                        and is_param(tgt.value.value):
+                    yield self.finding(
+                        sf, node,
+                        f"'{info.name}' flips writeable on its argument "
+                        f"'{tgt.value.value.id}': the caller's buffer "
+                        "changes behaviour behind its back — document "
+                        "the contract and freeze the base chain, or "
+                        "copy instead")
+                elif isinstance(tgt, ast.Subscript) and is_param(tgt.value):
+                    yield self.finding(
+                        sf, node,
+                        f"'{info.name}' writes in place into its "
+                        f"argument '{tgt.value.id}': callers retaining "
+                        "the array observe the mutation (and zero-copy "
+                        "device aliases of it go stale)")
+        elif isinstance(node, ast.AugAssign):
+            tgt = node.target
+            if isinstance(tgt, ast.Subscript) and is_param(tgt.value):
+                yield self.finding(
+                    sf, node,
+                    f"'{info.name}' updates its argument "
+                    f"'{tgt.value.id}' in place")
+        elif isinstance(node, ast.Call):
+            fname = dotted(node.func) or ""
+            if fname in ("np.copyto", "numpy.copyto") and node.args \
+                    and is_param(node.args[0]):
+                yield self.finding(
+                    sf, node,
+                    f"'{info.name}' np.copyto()s into its argument "
+                    f"'{node.args[0].id}'")
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _INPLACE_METHODS \
+                    and is_param(node.func.value):
+                yield self.finding(
+                    sf, node,
+                    f"'{info.name}' calls .{node.func.attr}() on its "
+                    f"argument '{node.func.value.id}' (in-place)")
+
+
+_MOD_NAME = ("mod", "modularity", "q")
+_SUM_CALLS = {"segment_sum", "sum"}
+# Substrings of the assigned expression that mark the exact path (the
+# ds_* double-single helpers / ops.exactsum); accum_dtype-style params
+# are checked separately on the enclosing function.
+_EXACT_MARKERS = ("ds_", "exactsum")
+
+
+def _is_mod_name(name: str) -> bool:
+    low = name.lower()
+    if "modularity" in low:
+        return True
+    parts = low.split("_")
+    return parts[0] in _MOD_NAME or parts[-1] in _MOD_NAME
+
+
+@register
+class InexactModularityReduction(Rule):
+    id = "R006"
+    severity = "medium"
+    title = "non-exact reduction feeding a modularity accumulator"
+
+    def check(self, sf):
+        if not (sf.rel.startswith("cuvite_tpu/louvain/")
+                or sf.rel.startswith("cuvite_tpu/evaluate/")):
+            return
+        for node in sf.walk():
+            if not isinstance(node, ast.Assign):
+                continue
+            names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if not any(_is_mod_name(n) for n in names):
+                continue
+            sub = ast.dump(node.value)
+            if any(m in sub for m in _EXACT_MARKERS):
+                continue  # already on the exact path
+            info = sf.enclosing_function(node)
+            if info is not None and any(
+                    "accum" in p or p == "adt" for p in info.params):
+                continue  # dtype-policy-aware: width chosen by caller
+            for call in ast.walk(node.value):
+                if not isinstance(call, ast.Call):
+                    continue
+                fname = dotted(call.func) or (
+                    call.func.attr if isinstance(call.func, ast.Attribute)
+                    else "")
+                if fname.split(".")[-1] in _SUM_CALLS:
+                    yield self.finding(
+                        sf, node,
+                        f"modularity accumulator '{names[0]}' fed by "
+                        f"{fname.split('.')[-1]}() without the exact "
+                        "path: f32 tree sums lose ~log2(n)*2^-24 "
+                        "relative — enough to flip the 1e-6 convergence "
+                        "test at scale; use ops.exactsum (ds32) or an "
+                        "accum_dtype-aware reduction")
+                    break
+
+
+_SUBPROCESS_BLOCKING = {
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+}
+
+
+@register
+class SubprocessNoTimeout(Rule):
+    id = "R007"
+    severity = "high"
+    title = "blocking subprocess call without a timeout in tools/"
+
+    def check(self, sf):
+        if not sf.rel.startswith("tools/"):
+            return
+        for node in sf.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            if fname not in _SUBPROCESS_BLOCKING:
+                continue
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            if any(kw.arg is None for kw in node.keywords):
+                continue  # **kwargs may carry a timeout: cannot prove
+            yield self.finding(
+                sf, node,
+                f"{fname}() without timeout=: a hung child (TPU client "
+                "handshake, OOM-thrash) wedges the whole tool run "
+                "forever; pass a generous timeout and handle "
+                "TimeoutExpired loudly")
+
+
+_EMPTYISH = (None, "", "0")
+
+
+def _env_get_polarity(sf, call: ast.Call, test: ast.expr):
+    """How the env-get GATES ``test``: True — the branch cannot be taken
+    unless the variable is set to an opt-in value; False — the branch
+    cannot be taken WHILE it is set (``not get(X)``: the else branch is
+    then the opted-in one); None — cannot prove either (an ``or`` arm or
+    truthy default lets the branch fire regardless, and unknown
+    constructs are treated the same, conservatively).
+
+    Polarity flips: ``not`` flips; ``== / is`` against None/''/'0' flips
+    (``get(X) is None`` means NOT set); ``!= / is not`` against those
+    keeps; against any other constant, equality keeps (``== '1'`` is an
+    explicit opt-in value) and inequality flips (``!= '1'`` is true
+    whenever the var is unset — opt-out, rephrased).  Only ``and``
+    conjunctions may sit between the get and the test root."""
+    defaults = list(call.args[1:2]) + [
+        kw.value for kw in call.keywords if kw.arg == "default"]
+    for d in defaults:
+        if not (isinstance(d, ast.Constant) and d.value in _EMPTYISH):
+            return None  # truthy (or unprovable) default: true while unset
+    positive = True
+    if call is test:
+        return positive
+    child = call
+    for anc in sf.ancestors(call):
+        if isinstance(anc, ast.UnaryOp) and isinstance(anc.op, ast.Not):
+            positive = not positive
+        elif isinstance(anc, ast.Compare):
+            if not (anc.comparators and child is anc.left
+                    and isinstance(anc.comparators[0], ast.Constant)):
+                return None  # yoda/chained forms: cannot prove gating
+            op, cmp_ = anc.ops[0], anc.comparators[0]
+            emptyish = cmp_.value in _EMPTYISH
+            if isinstance(op, (ast.Eq, ast.Is)):
+                positive ^= emptyish
+            elif isinstance(op, (ast.NotEq, ast.IsNot)):
+                positive ^= not emptyish
+            else:
+                return None
+        elif isinstance(anc, ast.BoolOp):
+            if not isinstance(anc.op, ast.And):
+                return None  # an `or` arm bypasses the env var
+        else:
+            return None  # wrapped in a call/ifexp/...: cannot prove
+        child = anc
+        if anc is test:
+            break
+    return positive
+
+
+def _opt_in_gated(sf, node) -> bool:
+    """True if an ancestor ``if`` gates ``node`` on an os.environ.get /
+    os.getenv whose polarity matches the BRANCH holding ``node``: the
+    ``if`` body needs positive polarity (the opt-in idiom), the ``else``
+    branch needs negative (the else of ``if not get(X)`` runs only when
+    X is set).  Everything else — opt-OUT spellings (``not get(X)``,
+    ``get(X) is None``, ``get(X) != '1'``), the else of an opt-IN check
+    (runs by default when unset!), truthy defaults — does not count."""
+    prev = node
+    for anc in sf.ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+        if isinstance(anc, ast.If) and prev is not anc.test:
+            in_body = any(prev is s for s in anc.body)
+            in_orelse = any(prev is s for s in anc.orelse)
+            for n in ast.walk(anc.test):
+                if isinstance(n, ast.Call):
+                    cname = dotted(n.func) or ""
+                    if cname not in ("os.environ.get", "os.getenv") \
+                            and not cname.endswith("environ.get"):
+                        continue
+                    pol = _env_get_polarity(sf, n, anc.test)
+                    if (in_body and pol is True) \
+                            or (in_orelse and pol is False):
+                        return True
+        prev = anc
+    return False
+
+
+@register
+class HostGlobalTestSideEffect(Rule):
+    id = "R008"
+    severity = "high"
+    title = "host-global side effect in tests without opt-in gating"
+
+    def check(self, sf):
+        if not (sf.rel.startswith("tests/")
+                or sf.rel.endswith("conftest.py")):
+            return
+        for node in sf.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            if fname == "open":
+                target = node.args[0] if node.args else None
+                mode = None
+                if len(node.args) > 1:
+                    mode = node.args[1]
+                for kw in node.keywords:
+                    if kw.arg == "mode":
+                        mode = kw.value
+                if not (isinstance(target, ast.Constant)
+                        and isinstance(target.value, str)
+                        and target.value.startswith("/proc/sys")):
+                    continue
+                if not (isinstance(mode, ast.Constant)
+                        and isinstance(mode.value, str)
+                        and any(c in mode.value for c in "wa+")):
+                    continue
+                if _opt_in_gated(sf, node):
+                    continue
+                yield self.finding(
+                    sf, node,
+                    f"sysctl write ({target.value}) in a test fixture "
+                    "without an opt-in env gate: a HOST-GLOBAL knob "
+                    "silently changed for everything else on the "
+                    "machine; gate it on an explicit CUVITE_*=1 opt-in "
+                    "and restore the prior value at session finish")
+            elif fname == "os.putenv":
+                if _opt_in_gated(sf, node):
+                    continue
+                yield self.finding(
+                    sf, node,
+                    "os.putenv() in tests bypasses os.environ "
+                    "bookkeeping (leaks into every child, invisible to "
+                    "os.environ readers); assign os.environ[...] "
+                    "instead, or gate behind an opt-in")

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from cuvite_tpu.comm.mesh import shard_map
 from cuvite_tpu.ops import segment as seg
 
 # Width ladder: ~1.5-2x steps bound the padded-slot inflation (a row of
@@ -1023,7 +1024,7 @@ def make_sharded_class_step(mesh, axis_name: str, n_buckets: int,
         nshards, budget = 1, 0
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=out_specs,
@@ -1068,7 +1069,7 @@ def make_sharded_bucketed_mod(mesh, axis_name: str, n_buckets: int,
         out_specs = P()
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=out_specs,
@@ -1111,7 +1112,7 @@ def make_sharded_bucketed_step(mesh, axis_name: str, n_buckets: int,
         nshards, budget = 1, 0
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=out_specs,

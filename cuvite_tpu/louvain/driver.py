@@ -1264,10 +1264,20 @@ def louvain_phases(
                 # Same directory, different graph content (e.g. same-scale
                 # R-MAT with another seed): composing its labels would be
                 # silently wrong, and silently restarting would hide it.
+                # Per-host ingest note: DistVite.content_fingerprint hashes
+                # the PARTITIONED layout, so partition parameters are part
+                # of the digest there — a changed nshards/balanced split of
+                # the very same graph also lands here, by design (failing
+                # closed on partition drift).
                 raise ValueError(
                     f"checkpoint in {checkpoint_dir!r} was written for a "
-                    "different graph (content fingerprint mismatch); use a "
-                    "fresh --checkpoint-dir or drop --resume")
+                    "different graph (content fingerprint mismatch). With "
+                    "per-host ingest the fingerprint also covers the "
+                    "partition parameters (nshards / balanced), so a "
+                    "changed partitioning of the SAME graph is reported "
+                    "here too, not just different graph content; resume "
+                    "with the original partition settings, or use a fresh "
+                    "--checkpoint-dir / drop --resume")
         if ck is not None and len(ck.comm_all) == nv0 \
                 and ck.orig_ne == graph.num_edges:
             g = ck.graph

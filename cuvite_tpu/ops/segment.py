@@ -141,7 +141,10 @@ def sort_edges_by_vertex_comm(src, ckey, w, *extras, src_bound=None,
         # int32 otherwise, corrupting keys); int32 packing is always safe.
         fits32 = kbits + sbits <= 31
         if fits32 or (kbits + sbits <= 63 and jax.config.jax_enable_x64):
-            pdt = jnp.int32 if fits32 else jnp.int64
+            # int64 is legal here BY CONSTRUCTION: the branch above only
+            # admits it under jax_enable_x64 (the oracle mode), never in
+            # the 32-bit graph mode R003 protects.
+            pdt = jnp.int32 if fits32 else jnp.int64  # graftlint: disable=R003
             packed = (src.astype(pdt) << kbits) | ckey.astype(pdt)
             out = jax.lax.sort((packed,) + (w,) + extras, num_keys=1)
             k_s = out[0]

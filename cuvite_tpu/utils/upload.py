@@ -68,6 +68,17 @@ def aligned_copy(a: np.ndarray) -> np.ndarray:
 def to_device(x, dtype=None):
     """jnp.asarray with the copies removed where legal (see module doc).
 
+    SIDE EFFECT on the zero-copy path: the source numpy array — and its
+    ``.base`` chain when it is a view — is frozen (``writeable=False``)
+    before returning, because the jax array aliases that exact memory.
+    A later host write through ``x`` or its bases then raises instead of
+    silently corrupting device state.  Best-effort, not a guarantee:
+    numpy captures writeability per-array at view creation, so a SIBLING
+    view taken before this call still writes into the aliased buffer
+    unchecked — don't keep other views of an uploaded array around.
+    Callers that need to keep mutating the source must pass a copy (or
+    set CUVITE_NO_ALIAS_UPLOAD=1).
+
     EVERY return path yields a COMMITTED array (an explicit
     SingleDeviceSharding): ``jnp.from_dlpack`` commits inherently, and the
     copy path commits via ``jax.device_put``.  This is a correctness
@@ -91,8 +102,17 @@ def to_device(x, dtype=None):
         else:
             # The jax array reads this exact memory from now on: freeze the
             # numpy side so a later host mutation raises instead of silently
-            # corrupting device state.
-            x.flags.writeable = False
+            # corrupting device state.  Freezing x alone is NOT enough when
+            # x is a view (every aligned_* allocator above returns a view
+            # of a uint8 buffer): a write through the base would still land
+            # in the aliased memory with x.flags untouched.  Freeze the
+            # whole .base chain.  (Sibling views created BEFORE this call
+            # keep their own writeable flag — numpy offers no way to reach
+            # them — so the guard is best-effort; see docstring.)
+            b = x
+            while isinstance(b, np.ndarray):
+                b.flags.writeable = False
+                b = b.base
             return out
     # local_devices, not devices: in a multi-process run devices()[0] is
     # process 0's (non-addressable elsewhere), and the two paths would

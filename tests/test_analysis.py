@@ -1,0 +1,649 @@
+"""graftlint tests: per-rule positive/negative fixtures, suppression
+handling, baseline round-trip, and the repo self-lint gate.
+
+The self-lint gate (test_selflint_no_new_high_findings) is the tier-1
+enforcement the subsystem exists for: a PR introducing a new
+high-severity hazard anywhere in cuvite_tpu/, tools/, or tests/ fails
+the suite, with the checked-in baseline (tools/graftlint_baseline.json)
+grandfathering whatever was already there when the rule landed.
+
+All fixtures are tiny inline source STRINGS — never repo files — so a
+rule's semantics are pinned independently of the codebase's current
+state.  ``rel`` paths on fixtures exercise the directory scoping rules
+(R003 device-path modules, R007 tools/, R008 tests/).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cuvite_tpu.analysis import (
+    all_rules,
+    apply_baseline,
+    load_baseline,
+    run_paths,
+    run_source,
+    write_baseline,
+)
+from cuvite_tpu.analysis.engine import Finding, gate_failures
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO, "tools", "graftlint_baseline.json")
+
+SCAN_PATHS = ("cuvite_tpu", "tools", "tests")
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# Per-rule fixtures: (rule id, triggering source, clean source, rel path).
+# The clean variant stays as close to the bad one as the rule allows, so
+# each pair pins the rule's discriminating feature, not its surface syntax.
+
+RULE_CASES = [
+    (
+        "R001",
+        """
+import jax
+import numpy as np
+
+@jax.jit
+def step(x):
+    return _helper(x)
+
+def _helper(x):
+    x.block_until_ready()
+    v = float(x.sum())
+    return np.asarray(v), x.item()
+""",
+        """
+import jax
+import numpy as np
+
+@jax.jit
+def step(x):
+    return x * 2
+
+def _host_report(x):
+    # identical host-sync calls, but NOT reachable from any jitted
+    # function in this module
+    x.block_until_ready()
+    v = float(x.sum())
+    return np.asarray(v), x.item()
+""",
+        "cuvite_tpu/fake_r001.py",
+    ),
+    (
+        "R002",
+        """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnums=tuple(range(2)))
+def f(a, b, x):
+    if x > 0:
+        return x
+    return -x
+""",
+        """
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnums=(0, 1), static_argnames=("m",))
+def f(a, b, x, *, m=4):
+    if a > 0:          # static: branch is resolved at trace time
+        return x * m
+    if x is None:      # structural dispatch, not data-dependent
+        return b
+    return -x
+""",
+        "cuvite_tpu/fake_r002.py",
+    ),
+    (
+        "R003",
+        """
+import jax.numpy as jnp
+import numpy as np
+
+def device_ids(n):
+    pad = jnp.zeros(n, dtype="int64")
+    wide = jnp.full(n, 0, dtype=np.int64)
+    cast = jnp.arange(n).astype("int64")
+    return pad.astype(jnp.float64), wide, cast
+""",
+        """
+import jax.numpy as jnp
+import numpy as np
+
+def device_ids(n):
+    # np 64-bit HOST arrays are fine (plan building); only jnp device
+    # constructions defeat the 32-bit graph mode
+    host = np.zeros(n, dtype=np.int64)
+    return jnp.asarray(host, dtype=jnp.int32)
+""",
+        "cuvite_tpu/louvain/fake_r003.py",
+    ),
+    (
+        "R004",
+        """
+import jax
+from cuvite_tpu.comm.multihost import allgather_varlen, gather_global
+
+def resume(path, arr):
+    try:
+        state = allgather_varlen(arr)
+    except ValueError:
+        state = None
+    if jax.process_index() == 0:
+        return gather_global(arr)
+    if _load(path):
+        return gather_global(arr)
+    return state
+
+def _load(path):
+    return None
+""",
+        """
+from cuvite_tpu.comm.multihost import allgather_varlen, gather_global, \\
+    is_distributed
+
+def resume(dist_ingest, arr):
+    if dist_ingest:          # replicated plain value: uniform by contract
+        state = allgather_varlen(arr)
+    if is_distributed():     # known-uniform predicate
+        return gather_global(arr)
+    return state
+""",
+        "cuvite_tpu/fake_r004.py",
+    ),
+    (
+        "R005",
+        """
+import numpy as np
+
+def freeze(x, out, acc):
+    x.flags.writeable = False
+    out[:10] = 0
+    np.copyto(out, x)
+    acc.fill(0)
+    acc += 1 if False else 0
+""",
+        """
+import numpy as np
+
+def freeze(x_ref, o_ref):
+    # pallas kernel convention: *_ref params are output Refs
+    o_ref[...] = x_ref[...]
+
+def local_only(x):
+    out = np.empty_like(x)
+    out[:10] = 0          # local allocation: ours to mutate
+    out.flags.writeable = False
+    np.copyto(out, out)
+    return out
+""",
+        "cuvite_tpu/fake_r005.py",
+    ),
+    (
+        "R006",
+        """
+import jax.numpy as jnp
+from jax.ops import segment_sum
+
+def phase_q(e_c, a_c, seg, n):
+    mod = jnp.sum(e_c) - segment_sum(a_c, seg, num_segments=n).sum()
+    return mod
+""",
+        """
+import jax.numpy as jnp
+from cuvite_tpu.ops.exactsum import ds_tree_sum, ds_to_f64
+
+def phase_q(e_c, a_c):
+    mod = ds_tree_sum(e_c - a_c ** 2)
+    return mod
+
+def stepped_q(e_c, accum_dtype):
+    # dtype-policy-aware: the caller chose the accumulation width
+    mod = jnp.sum(e_c.astype(accum_dtype))
+    return mod
+""",
+        "cuvite_tpu/louvain/fake_r006.py",
+    ),
+    (
+        "R007",
+        """
+import subprocess
+import sys
+
+def bench(cmd):
+    return subprocess.run([sys.executable] + cmd, capture_output=True)
+""",
+        """
+import subprocess
+import sys
+
+def bench(cmd):
+    return subprocess.run([sys.executable] + cmd, capture_output=True,
+                          timeout=7200)
+""",
+        "tools/fake_r007.py",
+    ),
+    (
+        "R008",
+        """
+import os
+
+if not os.environ.get("NO_SYSCTL"):   # opt-OUT: fires by default
+    with open("/proc/sys/vm/max_map_count", "w") as f:
+        f.write("1048576")
+""",
+        """
+import os
+
+if os.environ.get("RAISE_SYSCTL"):    # opt-IN: off by default
+    with open("/proc/sys/vm/max_map_count", "w") as f:
+        f.write("1048576")
+with open("/proc/sys/vm/max_map_count") as f:   # read-only: fine
+    cur = int(f.read())
+""",
+        "tests/fake_r008.py",
+    ),
+]
+
+RULE_IDS = [c[0] for c in RULE_CASES]
+
+
+@pytest.mark.parametrize("rule_id,bad,good,rel", RULE_CASES, ids=RULE_IDS)
+def test_rule_positive(rule_id, bad, good, rel):
+    findings = run_source(bad, rel=rel)
+    assert rule_id in rules_of(findings), \
+        f"{rule_id} did not fire on its positive fixture: {findings}"
+
+
+@pytest.mark.parametrize("rule_id,bad,good,rel", RULE_CASES, ids=RULE_IDS)
+def test_rule_negative(rule_id, bad, good, rel):
+    findings = run_source(good, rel=rel)
+    assert rule_id not in rules_of(findings), \
+        f"{rule_id} false-positive on its clean fixture: " \
+        f"{[f.format() for f in findings if f.rule == rule_id]}"
+
+
+def test_registry_ships_at_least_eight_rules():
+    rules = all_rules()
+    assert len(rules) >= 8
+    assert {r.id for r in rules} >= set(RULE_IDS)
+    for r in rules:
+        assert r.severity in ("high", "medium", "low")
+        assert r.title
+
+
+# ---------------------------------------------------------------------------
+# Severity / finding counts on the positive fixtures
+
+
+def test_positive_fixture_severities_match_registry():
+    sev = {r.id: r.severity for r in all_rules()}
+    for rule_id, bad, _good, rel in RULE_CASES:
+        for f in run_source(bad, rel=rel):
+            if f.rule == rule_id:
+                assert f.severity == sev[rule_id]
+
+
+def test_r001_flags_each_sync_call_site():
+    bad = RULE_CASES[0][1]
+    hits = [f for f in run_source(bad, rel="cuvite_tpu/x.py")
+            if f.rule == "R001"]
+    # block_until_ready, float, np.asarray, .item
+    assert len(hits) == 4
+
+
+def test_r003_scope_is_device_path_only():
+    bad = RULE_CASES[2][1]  # the R003-triggering source
+    assert any(f.rule == "R003"
+               for f in run_source(bad, rel="cuvite_tpu/ops/x.py"))
+    # the SAME source outside louvain/kernels/ops is out of scope
+    assert not any(f.rule == "R003"
+                   for f in run_source(bad, rel="cuvite_tpu/io/x.py"))
+
+
+R008_GUARD = """
+import os
+
+if %s:
+    with open("/proc/sys/vm/max_map_count", "w") as f:
+        f.write("1048576")
+"""
+
+
+@pytest.mark.parametrize("guard,fires", [
+    ("os.environ.get('X')", False),               # opt-in
+    ("os.environ.get('X') == '1'", False),        # opt-in, explicit value
+    ("os.environ.get('X') is not None", False),   # opt-in
+    ("os.environ.get('X', '') != ''", False),     # opt-in
+    ("not (os.environ.get('X') is None)", False),  # opt-in, double flip
+    ("FLAG and os.environ.get('X')", False),      # conjunction still gates
+    ("not os.environ.get('NO_X')", True),         # opt-out
+    ("os.environ.get('NO_X') is None", True),     # opt-out, rephrased
+    ("os.environ.get('NO_X') == ''", True),       # opt-out, rephrased
+    ("os.environ.get('NO_X') != '1'", True),      # opt-out, rephrased
+    ("FLAG or os.environ.get('X')", True),        # or-arm bypasses the gate
+    ("os.environ.get('X', '1')", True),           # truthy default: not a gate
+    ("os.environ.get('X', default='1')", True),   # keyword default, same
+])
+def test_r008_gate_polarity(guard, fires):
+    findings = run_source(R008_GUARD % guard, rel="tests/x.py")
+    assert ("R008" in rules_of(findings)) == fires, (guard, findings)
+
+
+R008_ELSE = """
+import os
+
+if %s:
+    pass
+else:
+    with open("/proc/sys/vm/max_map_count", "w") as f:
+        f.write("1048576")
+"""
+
+
+@pytest.mark.parametrize("guard,fires", [
+    # else of an opt-IN check runs by default when the var is UNSET
+    ("os.environ.get('RAISE_X')", True),
+    # else of an opt-OUT check runs only when the var IS set: genuine gate
+    ("not os.environ.get('NO_X')", False),
+    # unprovable polarity must not gate the else branch either
+    ("FLAG or os.environ.get('X')", True),
+])
+def test_r008_else_branch_polarity(guard, fires):
+    findings = run_source(R008_ELSE % guard, rel="tests/x.py")
+    assert ("R008" in rules_of(findings)) == fires, (guard, findings)
+
+
+def test_r007_scope_is_tools_only():
+    bad = RULE_CASES[6][1]
+    assert not any(f.rule == "R007"
+                   for f in run_source(bad, rel="cuvite_tpu/x.py"))
+
+
+# ---------------------------------------------------------------------------
+# Suppressions
+
+SUPPRESSIBLE = """
+import subprocess
+
+def bench(cmd):
+    return subprocess.run(cmd)%s
+"""
+
+
+def test_line_suppression():
+    dirty = run_source(SUPPRESSIBLE % "", rel="tools/x.py")
+    assert rules_of(dirty) == {"R007"}
+    clean = run_source(SUPPRESSIBLE % "  # graftlint: disable=R007",
+                       rel="tools/x.py")
+    assert clean == []
+
+
+def test_line_suppression_is_rule_specific():
+    still = run_source(SUPPRESSIBLE % "  # graftlint: disable=R001",
+                       rel="tools/x.py")
+    assert rules_of(still) == {"R007"}
+
+
+def test_line_suppression_all():
+    clean = run_source(SUPPRESSIBLE % "  # graftlint: disable=all",
+                       rel="tools/x.py")
+    assert clean == []
+
+
+def test_file_suppression_within_pragma_window():
+    src = "# graftlint: disable-file=R007\n" + SUPPRESSIBLE % ""
+    assert run_source(src, rel="tools/x.py") == []
+
+
+def test_file_suppression_ignored_past_pragma_window():
+    pad = "\n" * 40
+    src = SUPPRESSIBLE % "" + pad + "# graftlint: disable-file=R007\n"
+    assert rules_of(run_source(src, rel="tools/x.py")) == {"R007"}
+
+
+# ---------------------------------------------------------------------------
+# Baseline round-trip
+
+
+def _dirty_findings():
+    return run_source(SUPPRESSIBLE % "", rel="tools/x.py")
+
+
+def test_baseline_roundtrip(tmp_path):
+    findings = _dirty_findings()
+    assert findings
+    bl_path = str(tmp_path / "baseline.json")
+    write_baseline(bl_path, findings)
+
+    baseline = load_baseline(bl_path)
+    new, grandfathered = apply_baseline(_dirty_findings(), baseline)
+    assert new == []
+    assert len(grandfathered) == len(findings)
+    assert gate_failures(new) == []
+
+
+def test_baseline_survives_line_drift(tmp_path):
+    bl_path = str(tmp_path / "baseline.json")
+    write_baseline(bl_path, _dirty_findings())
+    # Same violation, shifted down by unrelated edits above it: the
+    # fingerprint is (path, rule, stripped line), so it stays baselined.
+    drifted = run_source("\n# a new comment\n\n" + SUPPRESSIBLE % "",
+                         rel="tools/x.py")
+    new, old = apply_baseline(drifted, load_baseline(bl_path))
+    assert new == [] and len(old) == 1
+
+
+def test_baseline_does_not_mask_new_findings(tmp_path):
+    bl_path = str(tmp_path / "baseline.json")
+    write_baseline(bl_path, _dirty_findings())
+    two = SUPPRESSIBLE % "" + """
+def bench2(cmd):
+    return subprocess.run(cmd, check=True)
+"""
+    new, old = apply_baseline(run_source(two, rel="tools/x.py"),
+                              load_baseline(bl_path))
+    assert len(old) == 1  # the grandfathered original
+    assert len(new) == 1 and new[0].rule == "R007"
+    assert gate_failures(new)
+
+
+def test_e000_is_never_baselineable(tmp_path):
+    """A grandfathered parse error must not permanently un-lint a file:
+    E000 findings are excluded from write_baseline AND never match a
+    (possibly hand-edited) baseline entry."""
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    findings = run_paths([str(bad)])
+    assert [f.rule for f in findings] == ["E000"]
+    bl_path = str(tmp_path / "baseline.json")
+    write_baseline(bl_path, findings)
+    assert load_baseline(bl_path) == {}  # not written...
+    forged = {findings[0].fingerprint(): 1}
+    new, old = apply_baseline(findings, forged)  # ...and never matched
+    assert old == [] and new == findings
+
+
+def test_pragma_inside_string_literal_is_ignored():
+    """A docstring QUOTING the suppression syntax must not disable the
+    gate for the file that quotes it."""
+    src = '''"""Docs.
+
+The suppression syntax is:
+# graftlint: disable-file=all
+"""
+import subprocess
+
+def bench(cmd):
+    return subprocess.run(cmd)
+'''
+    assert rules_of(run_source(src, rel="tools/x.py")) == {"R007"}
+    # ...while a REAL comment pragma still works
+    real = "# graftlint: disable-file=R007\n" + src
+    assert run_source(real, rel="tools/x.py") == []
+
+
+def test_baseline_missing_file_is_empty():
+    assert load_baseline("/nonexistent/baseline.json") == {}
+
+
+def test_baseline_counts_duplicates(tmp_path):
+    f = Finding(rule="R007", severity="high", path="tools/x.py", line=4,
+                message="m", snippet="subprocess.run(cmd)")
+    g = Finding(rule="R007", severity="high", path="tools/x.py", line=9,
+                message="m", snippet="subprocess.run(cmd)")
+    bl_path = str(tmp_path / "baseline.json")
+    write_baseline(bl_path, [f])  # ONE slot for this fingerprint
+    new, old = apply_baseline([f, g], load_baseline(bl_path))
+    assert len(old) == 1 and len(new) == 1
+
+
+# ---------------------------------------------------------------------------
+# Engine behaviour
+
+
+def test_syntax_error_yields_gateable_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    findings = run_paths([str(p)])
+    assert len(findings) == 1
+    assert findings[0].rule == "E000" and findings[0].severity == "high"
+    assert gate_failures(findings)
+
+
+def test_unreadable_sources_fail_closed(tmp_path):
+    """Non-UTF8 bytes and null bytes must become E000 findings, not an
+    uncaught exception that discards every other file's findings."""
+    latin = tmp_path / "latin.py"
+    latin.write_bytes(b"# caf\xe9\n")
+    nul = tmp_path / "nul.py"
+    nul.write_bytes(b"x = 1\x00\n")
+    findings = run_paths([str(latin), str(nul)])
+    assert [f.rule for f in findings] == ["E000", "E000"]
+    assert gate_failures(findings)
+
+
+def test_barren_path_fails_closed(tmp_path):
+    """A typo'd / renamed input directory must NOT report a green gate."""
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    for bad in ("/nonexistent/tree", str(empty)):
+        findings = run_paths([bad])
+        assert [f.rule for f in findings] == ["E000"]
+        assert gate_failures(findings)
+
+
+def test_run_paths_walks_directories(tmp_path):
+    sub = tmp_path / "tools"
+    sub.mkdir()
+    (sub / "a.py").write_text(SUPPRESSIBLE % "")
+    (sub / "skip.txt").write_text("subprocess.run(x)")
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        findings = run_paths(["tools"])
+    finally:
+        os.chdir(cwd)
+    assert rules_of(findings) == {"R007"}
+    assert findings[0].path == "tools/a.py"
+
+
+# ---------------------------------------------------------------------------
+# The gate itself
+
+
+def test_selflint_no_new_high_findings(monkeypatch):
+    """THE tier-1 gate: zero non-baselined high-severity findings across
+    the repo's source, tools, and tests."""
+    monkeypatch.chdir(REPO)
+    findings = run_paths(SCAN_PATHS)
+    new, _ = apply_baseline(findings, load_baseline(BASELINE))
+    failures = gate_failures(new, "high")
+    assert not failures, \
+        "new high-severity graftlint findings (fix, suppress with a " \
+        "justified '# graftlint: disable=R###', or re-baseline " \
+        "deliberately via tools/lint.sh --write-baseline):\n" + \
+        "\n".join(f.format() for f in failures)
+
+
+def test_gate_is_cwd_independent(tmp_path, monkeypatch):
+    """Paths are anchored to the REPO ROOT, not the CWD: linting the
+    repo by absolute path from elsewhere must keep the scoped rules on
+    and the baseline matching."""
+    from cuvite_tpu.analysis.engine import _relpath
+
+    monkeypatch.chdir(tmp_path)
+    assert _relpath(os.path.join(REPO, "tools", "lint.sh")) \
+        == "tools/lint.sh"
+    findings = run_paths([os.path.join(REPO, p) for p in SCAN_PATHS])
+    assert all(not f.path.startswith(("/", "..")) for f in findings)
+    new, _ = apply_baseline(findings, load_baseline(BASELINE))
+    assert not gate_failures(new, "high")
+    # ...while trees OUTSIDE the repo resolve against the scan-root
+    # anchor, so scoped rules work on them from ANY CWD
+    sub = tmp_path / "deep" / "nested" / "tools"
+    sub.mkdir(parents=True)
+    (sub / "a.py").write_text(SUPPRESSIBLE % "")
+    assert rules_of(run_paths(["deep/nested/tools"])) == {"R007"}
+    monkeypatch.chdir("/")  # ancestor CWD: anchor must still win
+    assert rules_of(run_paths([str(sub)])) == {"R007"}
+    # a single FILE under a scoped dir keeps the scoping component too
+    assert rules_of(run_paths([str(sub / "a.py")])) == {"R007"}
+
+
+def test_write_baseline_cli_reports_e000(tmp_path, capsys):
+    """--write-baseline must not claim it captured unparsable files, and
+    must exit nonzero so a rebaseline doesn't green-wash an E000."""
+    from cuvite_tpu.analysis.__main__ import main
+
+    tree = tmp_path / "tools"
+    tree.mkdir()
+    (tree / "bad.py").write_text(SUPPRESSIBLE % "")
+    (tree / "broken.py").write_text("def f(:\n")
+    bl = str(tmp_path / "bl.json")
+    rc = main([str(tree), "--baseline", bl, "--write-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "wrote 1 finding(s)" in out and "NOT baselined" in out
+    assert len(load_baseline(bl)) == 1
+
+
+def test_cli_gate_matches_library(monkeypatch, capsys):
+    from cuvite_tpu.analysis.__main__ import main
+
+    monkeypatch.chdir(REPO)
+    rc = main(list(SCAN_PATHS) + ["--baseline", BASELINE,
+                                  "--format", "json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["gate"]["failures"] == 0
+
+
+def test_cli_list_rules(capsys):
+    from cuvite_tpu.analysis.__main__ import main
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in RULE_IDS:
+        assert rid in out
+
+
+@pytest.mark.slow
+def test_cli_subprocess_entrypoint():
+    """`python -m cuvite_tpu.analysis` works as a real child process
+    (what tools/lint.sh and CI invoke)."""
+    out = subprocess.run(
+        [sys.executable, "-m", "cuvite_tpu.analysis", *SCAN_PATHS,
+         "--baseline", BASELINE],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr

@@ -9,6 +9,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from cuvite_tpu.comm.mesh import shard_map
 from cuvite_tpu.ops import segment as seg
 from cuvite_tpu.ops.exactsum import ds_psum, ds_tree_sum
 
@@ -88,8 +89,8 @@ def test_ds_psum_exact_across_shards():
     vals = np.tile(np.array([2.0 ** 25, 1.0], np.float32), 4)  # 8 shards
 
     @jax.jit
-    @jax.shard_map(mesh=mesh, in_specs=P("x"), out_specs=P(),
-                   check_vma=False)
+    @shard_map(mesh=mesh, in_specs=P("x"), out_specs=P(),
+               check_vma=False)
     def f(x):
         pair = ds_tree_sum(x)   # per-shard scalar pair
         hi, lo = ds_psum(pair, "x")

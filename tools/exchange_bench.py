@@ -60,6 +60,16 @@ def run_one(scale: int, nsh: int, exchange: str):
 def main():
     scales = [int(s) for s in os.environ.get("AB_SCALES", "18 20").split()]
     nsh = int(os.environ.get("AB_SHARDS", "8"))
+    # Parse ONCE, up front: a malformed value is reported and replaced
+    # by the default BEFORE any child launches — not discovered as a
+    # ValueError partway through a multi-hour sweep.
+    try:
+        child_timeout = float(os.environ.get("AB_CHILD_TIMEOUT") or 7200)
+    except ValueError:
+        print(f"# ignoring malformed AB_CHILD_TIMEOUT="
+              f"{os.environ.get('AB_CHILD_TIMEOUT')!r}; using 7200s",
+              flush=True)
+        child_timeout = 7200.0
     one = os.environ.get("AB_EXCHANGE")  # subprocess mode: one config
     print(f"# backend={jax.default_backend()} "
           f"devices={len(jax.devices())} shards={nsh}", flush=True)
@@ -75,9 +85,26 @@ def main():
             # shared jit caches between the two configs.
             env = dict(os.environ, AB_SCALES=str(scale), AB_EXCHANGE=exchange,
                        AB_SHARDS=str(nsh))
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__)],
-                env=env, capture_output=True, text=True)
+            try:
+                # Generous ceiling: the slowest measured config (sparse,
+                # scale 22) ran ~16 min; the 2h default covers every
+                # scale this host can hold plus cold-compile headroom,
+                # while still unwedging an A/B run whose child hit a
+                # pathological stall (TPU client handshake, OOM thrash).
+                out = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    env=env, capture_output=True, text=True,
+                    timeout=child_timeout)
+            except subprocess.TimeoutExpired as e:
+                # Mirror the rc != 0 branch: a killed child must be LOUD,
+                # not a silently missing row in the A/B table.
+                tail = (e.stderr or b"")
+                tail = tail.decode(errors="replace") \
+                    if isinstance(tail, bytes) else tail
+                print(f"scale={scale} exchange={exchange}: TIMEOUT after "
+                      f"{e.timeout:.0f}s (child killed) {tail[-400:]}",
+                      flush=True)
+                continue
             if out.returncode != 0:
                 # A child that OOMs/crashes after printing its header must
                 # be LOUD, not reduced to its last stdout line.

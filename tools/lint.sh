@@ -1,0 +1,9 @@
+#!/bin/sh
+# graftlint pre-commit one-liner: the EXACT gate tests/test_analysis.py
+# enforces in tier-1 (new high-severity finding anywhere in cuvite_tpu/,
+# tools/, or tests/ => exit 1).  Extra args pass through, e.g.:
+#   tools/lint.sh --fail-on medium        # stricter local run
+#   tools/lint.sh --format json           # machine-readable findings
+# See ANALYSIS.md for the rule catalogue and suppression/baseline flow.
+cd "$(dirname "$0")/.." && exec python -m cuvite_tpu.analysis \
+    cuvite_tpu tools tests --baseline tools/graftlint_baseline.json "$@"
