@@ -1,11 +1,13 @@
-"""graftlint rule set R001..R008 (see ANALYSIS.md for the catalogue).
+"""graftlint rule set R001..R009 (see ANALYSIS.md for the catalogue).
 
 Each rule targets a hazard class this codebase has actually hit (or is
 one refactor away from hitting): host syncs inside jitted code, jit
 recompile traps, 64-bit dtype drift into the 32-bit device path,
 collective-order divergence across hosts, mutation of caller-owned
 buffers, non-exact reductions feeding modularity, unbounded child
-processes in tools, and host-global side effects in test fixtures.
+processes in tools, host-global side effects in test fixtures, and
+network access outside the workloads fetch path (or without checksum
+verification).
 
 Rules are heuristic by design: they trade completeness for a near-zero
 false-positive rate on idiomatic code, and every remaining intentional
@@ -593,3 +595,96 @@ class HostGlobalTestSideEffect(Rule):
                     "bookkeeping (leaks into every child, invisible to "
                     "os.environ readers); assign os.environ[...] "
                     "instead, or gate behind an opt-in")
+
+
+# The ONE module allowed to open network connections: the workloads
+# dataset registry's fetch path (which must checksum what it downloads).
+NETWORK_ALLOWED_FILE = "cuvite_tpu/workloads/registry.py"
+
+# Call names that open a network connection.  Matched on the dotted name
+# (or its last attribute for the bare-import spellings).
+_NET_CALL_NAMES = {
+    "urlopen", "urlretrieve",  # urllib.request.* / bare from-imports
+    "socket.create_connection", "ftplib.FTP",
+    "http.client.HTTPConnection", "http.client.HTTPSConnection",
+}
+_NET_CALL_PREFIXES = ("urllib.request.", "requests.")
+
+# Evidence that a function verifies what it downloaded: any call whose
+# name mentions a digest or an explicit checksum/verify helper.
+_CHECKSUM_MARKERS = ("sha256", "sha512", "sha1", "md5", "blake2",
+                     "checksum", "verify")
+
+_SUBPROCESS_ANY = _SUBPROCESS_BLOCKING | {"subprocess.Popen"}
+_DOWNLOADER_TOOLS = {"curl", "wget", "aria2c", "scp", "rsync"}
+
+
+def _is_net_call(name: str | None) -> bool:
+    if not name:
+        return False
+    return (name in _NET_CALL_NAMES
+            or name.split(".")[-1] in ("urlopen", "urlretrieve")
+            or name.startswith(_NET_CALL_PREFIXES))
+
+
+def _subprocess_downloader(node: ast.Call) -> str | None:
+    """The downloader binary name if this subprocess call shells out to
+    one (list or string first argument), else None."""
+    if not node.args:
+        return None
+    arg = node.args[0]
+    cands = []
+    if isinstance(arg, (ast.List, ast.Tuple)):
+        cands = [el.value for el in arg.elts
+                 if isinstance(el, ast.Constant) and isinstance(el.value, str)]
+    elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        cands = arg.value.split()
+    for c in cands:
+        base = c.rsplit("/", 1)[-1]
+        if base in _DOWNLOADER_TOOLS:
+            return base
+    return None
+
+
+@register
+class NetworkOutsideRegistry(Rule):
+    id = "R009"
+    severity = "high"
+    title = "network call outside the workloads fetch path, or a " \
+            "download without checksum verification"
+
+    def check(self, sf):
+        for node in sf.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            if _is_net_call(fname):
+                if sf.rel != NETWORK_ALLOWED_FILE:
+                    yield self.finding(
+                        sf, node,
+                        f"network call {fname}() outside "
+                        f"{NETWORK_ALLOWED_FILE}: dataset fetches live in "
+                        "the registry (offline rigs must fall back to the "
+                        "synthesizer, and every download must be "
+                        "checksum-verified there)")
+                    continue
+                info = sf.enclosing_function(node)
+                calls = info.calls if info is not None else set()
+                if not any(any(m in c.lower() for m in _CHECKSUM_MARKERS)
+                           for c in calls):
+                    yield self.finding(
+                        sf, node,
+                        f"download via {fname}() without checksum "
+                        "verification in the same function: a truncated "
+                        "or tampered artifact would convert silently; "
+                        "hash the stream (hashlib.sha256) and verify "
+                        "before use")
+            elif dotted(node.func) in _SUBPROCESS_ANY:
+                tool = _subprocess_downloader(node)
+                if tool is not None:
+                    yield self.finding(
+                        sf, node,
+                        f"subprocess download via '{tool}': shelling out "
+                        "skips the registry's checksum verification and "
+                        "offline fallback; use "
+                        "cuvite_tpu.workloads.registry.fetch instead")

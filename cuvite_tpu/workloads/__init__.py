@@ -1,0 +1,4 @@
+"""Real-graph workload subsystem: converters, dataset registry with an
+offline synthesizer fallback, golden result envelopes, and the hardened
+bench harness.  CLI: ``python -m cuvite_tpu.workloads {fetch,synth,
+convert,bench,verify-golden}``."""

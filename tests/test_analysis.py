@@ -253,6 +253,37 @@ with open("/proc/sys/vm/max_map_count") as f:   # read-only: fine
 """,
         "tests/fake_r008.py",
     ),
+    (
+        "R009",
+        """
+import urllib.request
+
+def fetch(url, dest):
+    with urllib.request.urlopen(url, timeout=60) as resp, \\
+            open(dest, "wb") as out:
+        out.write(resp.read())
+    return dest
+""",
+        """
+import hashlib
+import urllib.request
+
+def fetch(url, dest, expected):
+    h = hashlib.sha256()
+    with urllib.request.urlopen(url, timeout=60) as resp, \\
+            open(dest, "wb") as out:
+        buf = resp.read()
+        h.update(buf)
+        out.write(buf)
+    _verify_checksum(h.hexdigest(), expected, dest)
+    return dest
+
+def _verify_checksum(digest, expected, path):
+    if expected is not None and digest != expected:
+        raise ValueError(path)
+""",
+        "cuvite_tpu/workloads/registry.py",
+    ),
 ]
 
 RULE_IDS = [c[0] for c in RULE_CASES]
@@ -368,6 +399,35 @@ def test_r007_scope_is_tools_only():
     bad = RULE_CASES[6][1]
     assert not any(f.rule == "R007"
                    for f in run_source(bad, rel="cuvite_tpu/x.py"))
+
+
+def test_r009_network_outside_registry_fires_even_with_checksum():
+    # The GOOD registry fixture (checksum-verified download) is still a
+    # violation anywhere else: the allowed file is part of the contract.
+    good_registry = RULE_CASES[8][2]
+    for rel in ("cuvite_tpu/io/vite.py", "tools/grab.py", "tests/x.py"):
+        assert "R009" in rules_of(run_source(good_registry, rel=rel)), rel
+
+
+R009_SUBPROCESS = """
+import subprocess
+
+def grab(url, dest):
+    subprocess.run(%s, timeout=600, check=True)
+"""
+
+
+@pytest.mark.parametrize("argv,fires", [
+    ("['curl', '-o', dest, url]", True),
+    ("['wget', '-O', dest, url]", True),
+    ("'wget ' + url", False),            # non-constant: cannot prove
+    ("['/usr/bin/curl', url]", True),    # path-qualified downloader
+    ("['python', '-m', 'x']", False),    # not a downloader
+])
+def test_r009_subprocess_downloaders(argv, fires):
+    findings = run_source(R009_SUBPROCESS % argv,
+                          rel="cuvite_tpu/workloads/registry.py")
+    assert ("R009" in rules_of(findings)) == fires, (argv, findings)
 
 
 # ---------------------------------------------------------------------------

@@ -1,19 +1,25 @@
-"""Third-stage TPU ladder (round 4): bench-first retry for the missing
-platform=tpu BENCH artifact.
+"""THE TPU measurement ladder (one ladder, one watcher: this file, run
+by tools/tpu_watch3.sh).  Earlier generations (tpu_ladder.py, round-4
+stages A-C; tpu_ladder2.py, stages A2/D/E) are folded in here and
+deleted — VERDICT r5 weak #5.
 
-The 03:48-04:19Z alive window landed stages A (compiled Pallas parity +
-1.41x/1.79x vs XLA) and B (910 ms scale-18 step incl. tunnel rtt), but
-the stage-C bench crashed rc=1 with its stderr captured-and-lost, and
-the tunnel wedged.  On the NEXT alive window the priority flips:
+Bench-first priority (a mid-ladder tunnel wedge preserves the most
+valuable result first):
 
-  C'. bench.py scale 18 with a generous in-process budget, stderr saved
-      to tools/bench18_tpu_stderr.log (so a repeat failure is
-      diagnosable), JSON saved to tools/bench_tpu_s18_r4.json when the
-      platform is not the cpu fallback;
-  then tools/tpu_ladder2.py (wide-width Pallas parity A2, engine A/B D,
-      scale-22 bench E) inline.
+  C'. bench at scales 20 then 18 (the hardened harness in
+      cuvite_tpu/workloads/bench.py: warm-up, compile-count==0 guard on
+      the first timed run, shared JSON schema), stderr preserved per
+      scale, JSON checkpointed to disk the moment it exists;
+  A2. compiled Pallas row_argmax parity + min-of-5 timing for the WIDE
+      classes (64/256/2048) vs the XLA sorted-dedup twin — the widths
+      that have only ever run in interpret mode;
+  D.  full clustering A/B on chip: bucketed vs pallas vs fused engines,
+      rmat-18 and rmat-20 (--json lines logged);
+  E.  bench at scale 22;
+  then tools/heavy_ab.py (heavy-class kernel decision measurement).
 
-Run via tools/tpu_watch3.sh.  Success marker: tools/TPU_LADDER3_DONE.
+Success marker: tools/TPU_LADDER3_DONE (platform!=cpu bench JSON
+landed).  Every result appends to tools/tpu_ladder_r4.log immediately.
 """
 
 import json
@@ -23,6 +29,7 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 LOG = os.path.join(REPO, "tools", "tpu_ladder_r4.log")
 DONE = os.path.join(REPO, "tools", "TPU_LADDER3_DONE")
 
@@ -51,11 +58,11 @@ def probe(timeout_s=75):
 
 
 def stage_c_retry():
-    """Round-5 bench-first order (VERDICT r4 item 2): scale 20 first
-    (bench.py's TPU default, the number BASELINE tracks), then scale 18
-    (comparable with every recorded CPU number).  Each stage checkpoints
-    its JSON to disk immediately, so a tunnel wedge mid-ladder cannot
-    lose an earlier stage's result; stderr is preserved per scale."""
+    """Scale 20 first (the TPU default BASELINE tracks), then 18
+    (comparable with every recorded CPU number).  Each scale checkpoints
+    its JSON immediately so a tunnel wedge cannot lose it.  The bench's
+    own compile guard aborts (rc=3, no JSON) on a recompiling run —
+    which this log then shows instead of a silently-poisoned number."""
     got = False
     for scale, budget in (("20", "1400"), ("18", "700")):
         env = dict(os.environ, BENCH_SCALE=scale, BENCH_TIME_BUDGET=budget,
@@ -77,18 +84,136 @@ def stage_c_retry():
             f"wall={time.perf_counter()-t0:.0f}s "
             f"json={last[-1] if last else '?'} "
             f"(stderr: {errpath})")
+        if out.returncode == 3:
+            log("C': compile guard tripped — no JSON by design; see the "
+                "stderr log for the compile list")
         if out.returncode == 0 and last:
             try:
                 j = json.loads(last[-1])
-                if j.get("platform") != "cpu":
+                from cuvite_tpu.workloads.bench import validate_record
+
+                problems = validate_record(j)
+                if problems:
+                    log(f"C': record rejected by schema: {problems}")
+                elif j.get("platform") != "cpu":
                     with open(os.path.join(
-                            REPO, f"tools/bench_tpu_s{scale}_r5.json"),
+                            REPO, f"tools/bench_tpu_s{scale}.json"),
                             "w") as f:
                         f.write(last[-1] + "\n")
                     got = True
             except json.JSONDecodeError:
                 pass
     return got
+
+
+def stage_a2(jnp, np):
+    """Wide-width (64/256/2048) compiled Pallas parity + min-of-5 timing
+    vs the XLA sorted twin (folded from tpu_ladder2.py)."""
+    from cuvite_tpu.kernels.row_argmax import row_argmax_pallas
+    from cuvite_tpu.louvain.bucketed import _row_argmax_sorted
+
+    SENT = np.iinfo(np.int32).max
+    rng = np.random.default_rng(0)
+    for width, n_rows in ((64, 1 << 14), (256, 1 << 13), (2048, 1 << 11)):
+        nv = 50000
+        cmat = rng.integers(0, nv, size=(n_rows, width)).astype(np.int32)
+        wmat = (rng.integers(1, 32, size=(n_rows, width)) / 16.0
+                ).astype(np.float32)
+        curr = rng.integers(0, nv, size=n_rows).astype(np.int32)
+        cmat[: n_rows // 2, 0] = curr[: n_rows // 2]
+        vdeg = (rng.integers(1, 64, size=n_rows) / 4.0).astype(np.float32)
+        sl = np.where(cmat[:, 0] == curr, wmat[:, 0] / 2.0, 0.0
+                      ).astype(np.float32)
+        comm_deg = (rng.integers(1, 256, size=nv) / 8.0).astype(np.float32)
+        const = np.float32(1.0 / 64.0)
+        ay = comm_deg[cmat]
+        ax = comm_deg[curr] - vdeg
+        args_p = (jnp.asarray(np.ascontiguousarray(cmat.T)),
+                  jnp.asarray(np.ascontiguousarray(wmat.T)),
+                  jnp.asarray(np.ascontiguousarray(ay.T)),
+                  jnp.asarray(curr), jnp.asarray(vdeg), jnp.asarray(sl),
+                  jnp.asarray(ax), jnp.asarray(const))
+        args_x = (jnp.asarray(cmat), jnp.asarray(wmat), jnp.asarray(ay),
+                  None, jnp.asarray(curr), jnp.asarray(vdeg),
+                  jnp.asarray(sl), jnp.asarray(ax), jnp.asarray(const),
+                  SENT)
+
+        t0 = time.perf_counter()
+        bc, bg, c0 = row_argmax_pallas(*args_p, sentinel=SENT,
+                                       interpret=False)
+        bc_h = np.asarray(bc)
+        log(f"A2: width={width} pallas COMPILED ok "
+            f"(first call {time.perf_counter()-t0:.1f}s)")
+        ref = _row_argmax_sorted(*args_x, id_bound=nv)
+        # best_c/counter0 agree exactly; best_gain may differ in f32
+        # summation order for duplicate aggregation -> epsilon compare.
+        ok_c = (np.array_equal(bc_h, np.asarray(ref.best_c))
+                and np.array_equal(np.asarray(c0), np.asarray(ref.counter0)))
+        gmax = float(np.max(np.abs(
+            np.where(np.isfinite(np.asarray(bg)),
+                     np.asarray(bg) - np.asarray(ref.best_gain), 0.0))))
+        log(f"A2: width={width} vs XLA-sorted: best_c/counter0 "
+            f"{'PASS' if ok_c else 'FAIL'}, |dgain|max={gmax:.3g}")
+
+        def t5(fn):
+            ts = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                out = fn()
+                _ = float(np.asarray(out[0]).ravel()[0])
+                ts.append(time.perf_counter() - t0)
+            return min(ts)
+
+        tp = t5(lambda: row_argmax_pallas(*args_p, sentinel=SENT,
+                                          interpret=False))
+        tx = t5(lambda: _row_argmax_sorted(*args_x, id_bound=nv))
+        log(f"A2: width={width} rows={n_rows}: pallas {tp*1e3:.2f} ms vs "
+            f"XLA-sorted {tx*1e3:.2f} ms ({tx/max(tp,1e-9):.2f}x)")
+
+
+def stage_d(platform):
+    """Full clustering engine A/B on chip (folded from tpu_ladder2.py);
+    fused = one host sync per RUN (vs per phase): over a ~1s-rtt tunnel
+    per-phase syncs alone are a visible share of a scale-18 run."""
+    for scale in (18, 20):
+        for engine in ("bucketed", "pallas", "fused"):
+            cmd = [sys.executable, "-m", "cuvite_tpu.cli",
+                   "--rmat", str(scale), "--engine", engine,
+                   "--platform", platform, "--json", "--quiet"]
+            t0 = time.perf_counter()
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=2400, cwd=REPO)
+            wall = time.perf_counter() - t0
+            line = ""
+            for ln in reversed(out.stdout.strip().splitlines() or [""]):
+                if ln.startswith("{"):
+                    line = ln
+                    break
+            log(f"D: scale={scale} engine={engine} rc={out.returncode} "
+                f"wall={wall:.0f}s json={line or out.stderr[-200:]}")
+
+
+def stage_e():
+    """Scale-22 bench (folded from tpu_ladder2.py)."""
+    env = dict(os.environ, BENCH_SCALE="22", BENCH_TIME_BUDGET="1500",
+               BENCH_REPEATS="2")
+    t0 = time.perf_counter()
+    out = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                         capture_output=True, text=True, timeout=3600,
+                         env=env)
+    last = out.stdout.strip().splitlines()
+    log(f"E: bench scale=22 rc={out.returncode} "
+        f"wall={time.perf_counter()-t0:.0f}s "
+        f"json={last[-1] if last else '?'}")
+    if out.returncode == 0 and last:
+        try:
+            j = json.loads(last[-1])
+            if j.get("platform") != "cpu":
+                with open(os.path.join(REPO, "tools/bench_tpu_s22.json"),
+                          "w") as f:
+                    f.write(last[-1] + "\n")
+        except json.JSONDecodeError:
+            pass
 
 
 def main():
@@ -105,12 +230,29 @@ def main():
     log(f"LADDER3 start: {' '.join(parts)}")
     # stage_c_retry handles its own per-scale timeouts.
     got_tpu_json = stage_c_retry()
+
+    # In-process stages need the proven backend pinned here too.
+    import jax
+
+    jax.config.update("jax_platforms", parts[0])
+    from cuvite_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    import jax.numpy as jnp
+    import numpy as np
+
     try:
-        subprocess.run([sys.executable,
-                        os.path.join(REPO, "tools", "tpu_ladder2.py")],
-                       timeout=7200)
-    except subprocess.TimeoutExpired:
-        log("ladder2: TIMEOUT (7200s)")
+        stage_a2(jnp, np)
+    except Exception as e:
+        log(f"A2: FAILED {type(e).__name__}: {e}")
+    try:
+        stage_d(parts[0])
+    except Exception as e:
+        log(f"D: FAILED {type(e).__name__}: {e}")
+    try:
+        stage_e()
+    except Exception as e:
+        log(f"E: FAILED {type(e).__name__}: {e}")
     # Heavy-class decision measurement (heavy_kernel_design.md): tile
     # kernel vs XLA sorted path over (D, nv_ceil); its own dated log.
     try:
