@@ -1,4 +1,4 @@
-"""graftlint rule set R001..R009 (see ANALYSIS.md for the catalogue).
+"""graftlint rule set R001..R010 (see ANALYSIS.md for the catalogue).
 
 Each rule targets a hazard class this codebase has actually hit (or is
 one refactor away from hitting): host syncs inside jitted code, jit
@@ -688,3 +688,64 @@ class NetworkOutsideRegistry(Rule):
                         "skips the registry's checksum verification and "
                         "offline fallback; use "
                         "cuvite_tpu.workloads.registry.fetch instead")
+
+
+# Modules that carry device-resident phase-transition state (the slab
+# that coarsen/device.py keeps in HBM across phases).  A stray host
+# materialization here re-introduces the O(E) PCIe round-trip the device
+# coarsener exists to remove — the regression class ISSUE 3 closed.
+PHASE_TRANSITION_PREFIXES = (
+    "cuvite_tpu/louvain/",
+    "cuvite_tpu/coarsen/",
+)
+
+# Call spellings that pull a device array to the host wholesale.
+_HOST_PULL_CALLS = {"jax.device_get"}
+# np.asarray/np.array of a bare name that follows the device-array naming
+# convention in these modules (slab/label arrays are *_d / *_dev /
+# labels*).  Attributes and other expressions are out of scope: host plan
+# arrays are routinely np.asarray'd during plan construction, and flagging
+# them would bury the signal (near-zero-false-positive contract).
+_HOST_MATERIALIZE_CALLS = {"np.asarray", "numpy.asarray",
+                           "np.array", "numpy.array"}
+_DEVICE_NAME_SUFFIXES = ("_dev", "_d")
+_DEVICE_NAME_PREFIXES = ("labels",)
+
+
+@register
+class DeviceArrayHostPull(Rule):
+    id = "R010"
+    severity = "medium"
+    title = "device->host pull of a device-resident array in " \
+            "phase-transition code"
+
+    def check(self, sf):
+        if not sf.rel.startswith(PHASE_TRANSITION_PREFIXES):
+            return
+        for node in sf.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            if fname in _HOST_PULL_CALLS:
+                yield self.finding(
+                    sf, node,
+                    f"{fname}() in a phase-transition module: a device->"
+                    "host pull here puts O(E)/O(V) bytes back on the PCIe "
+                    "path the device-resident coarsening removed; keep "
+                    "the slab in HBM.  Scalar/stat syncs and THE final "
+                    "label gather are the allowed exceptions — carry an "
+                    "inline '# graftlint: disable=R010' with a "
+                    "justification")
+            elif fname in _HOST_MATERIALIZE_CALLS and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and (
+                        arg.id.endswith(_DEVICE_NAME_SUFFIXES)
+                        or arg.id.startswith(_DEVICE_NAME_PREFIXES)):
+                    yield self.finding(
+                        sf, node,
+                        f"{fname}({arg.id}) materializes a device-"
+                        "resident array (by naming convention) on the "
+                        "host inside phase-transition code; gather "
+                        "scalars instead, or justify with an inline "
+                        "disable (the final label gather is the "
+                        "allowlisted case)")

@@ -1,0 +1,197 @@
+"""On-device inter-phase coarsening: distbuildNextLevelGraph in HBM.
+
+The host pipeline (coarsen/rebuild.py — the bit-parity oracle for this
+module) runs after every phase: device_get the labels, np.unique
+renumber, relabel + coalesce the edge list on the host, rebuild the
+DistGraph, re-upload the slab.  Between two phases that is two O(E)
+PCIe crossings plus an idle device — the single biggest wall-clock
+lever left after the engine work (ISSUE 3; PASCO, arXiv:2412.13592,
+measures coarsening as the scalability bottleneck of multilevel
+clustering, and the GPU Louvain line keeps aggregation on-accelerator
+for the same reason, arXiv:1805.10904).
+
+This module is the device-resident equivalent, all under ``jax.jit``
+with static pow2-padded shapes:
+
+  1. ``device_renumber`` — dense renumbering of surviving communities
+     (presence scatter + exclusive prefix count over the padded label
+     space), matching the reference's sorted-order renumbering
+     (rebuild.cpp:167-197: smallest surviving label -> 0) and therefore
+     ``rebuild.renumber_communities`` exactly;
+  2. ``device_coarsen_slab`` — relabel both endpoints to dense ids and
+     coalesce duplicate (src, dst) pairs with the existing sort/segment
+     machinery (ops/segment.py), landing the coarse graph COMPACTED
+     into a prefix of the SAME slab class: out arrays keep the input's
+     [ne_pad] shape, real rows in [0, ne2), padding (src == nv_pad,
+     w == 0) after.  Phases whose coarse graph still fits the class
+     re-enter the same compiled step — zero retraces, zero transfers;
+     the driver drops to a smaller pow2 class only when the
+     one-scalar-per-phase host sync (already paid for convergence)
+     shows the graph fits, via ``shrink_slab``.
+
+Accumulation: duplicate-run weights sum in ``accum_dtype`` (default:
+the weight dtype; ``'ds32'`` = double-single pairs, collapsed to f32
+once — the scale-safe mode for self-loop runs whose intra-community
+mass exceeds f32's 2^24 integer range).  The host oracle accumulates
+f64 and casts once, so device == host bit-for-bit whenever the run
+sums are exactly representable (unit/dyadic weights — the parity
+suite's domain, tests/test_coarsen_device.py); beyond it the ds32 mode
+keeps ~2^-48 relative agreement.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+from cuvite_tpu.core.types import next_pow2
+from cuvite_tpu.ops import segment as seg
+from cuvite_tpu.ops.segment import DS_ACCUM
+
+
+def device_coarsen_enabled() -> bool:
+    """Device-resident coarsening is the default; CUVITE_DEVICE_COARSEN=0
+    keeps the host pipeline (the A/B lever and the escape hatch).  Read
+    per call, not at import, so tests and benches can toggle it."""
+    return os.environ.get("CUVITE_DEVICE_COARSEN", "1").lower() \
+        not in ("", "0", "false")
+
+
+@functools.partial(jax.jit, static_argnames=("nv_pad",))
+def device_renumber(comm, real_mask, *, nv_pad: int):
+    """Dense renumbering of the surviving community labels, on device.
+
+    ``comm``: [nv_pad] labels in the padded vertex id space (every real
+    vertex's label is a real vertex id < nv_pad); ``real_mask``: [nv_pad]
+    bool.  Returns ``(dense_map, nc)``: ``dense_map[c]`` is the dense id
+    of surviving community ``c`` in SORTED label order (smallest -> 0,
+    matching np.unique/rebuild.cpp:167-197); entries of labels that
+    survive nowhere are meaningless and must never be gathered.  ``nc``
+    is the surviving-community count (scalar, stays on device).
+    """
+    lab = jnp.where(real_mask, comm, nv_pad)
+    present = jnp.zeros((nv_pad + 1,), jnp.int32).at[lab].set(1, mode="drop")
+    present = present[:nv_pad]  # padding labels land in the dropped slot
+    dense_map = (jnp.cumsum(present) - present).astype(comm.dtype)
+    return dense_map, jnp.sum(present)
+
+
+@functools.partial(jax.jit, static_argnames=("nv_pad", "accum_dtype"))
+def device_coarsen_slab(src, dst, w, comm, real_mask, *, nv_pad: int,
+                        accum_dtype=None, dense_map=None, nc=None):
+    """Relabel + coalesce the resident edge slab into the next-phase slab.
+
+    ``src``: [ne_pad] local vertex ids (pad == nv_pad, sorted to the
+    tail); ``dst``: [ne_pad] padded-space tail ids (pad == 0, w == 0);
+    ``comm``: [nv_pad] phase-end labels; ``real_mask``: [nv_pad] bool.
+
+    Returns ``(src2, dst2, w2, dense_map, nc, ne2)``: the coarse slab in
+    the SAME [ne_pad] class, coalesced rows sorted by (src, dst) and
+    compacted into [0, ne2), padding (src == nv_pad, dst == 0, w == 0)
+    after; ``dense_map``/``nc`` as :func:`device_renumber`.  Intra-
+    community weight collapses onto the diagonal as self-loops
+    (rebuild.cpp:244-279), which keeps modularity consistent across
+    phases.  ``accum_dtype``: run-sum accumulator — None (weight dtype),
+    a dtype name, or ``'ds32'`` for double-single pairs.  ``dense_map``/
+    ``nc`` (pass both or neither): a precomputed :func:`device_renumber`
+    of the SAME ``(comm, real_mask)`` — the fused driver reuses the one
+    it already ran for label composition instead of renumbering twice.
+    """
+    ne_pad = src.shape[0]
+    wdt = w.dtype
+    if dense_map is None:
+        dense_map, nc = device_renumber(comm, real_mask, nv_pad=nv_pad)
+
+    pad = src >= nv_pad
+    safe_src = jnp.minimum(src, nv_pad - 1)
+    csrc = jnp.take(dense_map, jnp.take(comm, safe_src))
+    cdst = jnp.take(dense_map, jnp.take(comm, dst))
+    new_src = jnp.where(pad, jnp.asarray(nv_pad, src.dtype),
+                        csrc.astype(src.dtype))
+    new_dst = jnp.where(pad, jnp.zeros((), dst.dtype),
+                        cdst.astype(dst.dtype))
+    w_in = jnp.where(pad, jnp.zeros_like(w), w)
+
+    # Stable (src, dst) sort through the packed-key machinery: dense ids
+    # are < nc <= nv_pad, padding src == nv_pad sorts to the tail.
+    src_s, dst_s, w_s = seg.sort_edges_by_vertex_comm(
+        new_src, new_dst, w_in, src_bound=nv_pad + 1, key_bound=nv_pad)
+
+    starts = seg.run_starts(src_s, dst_s)
+    run_id = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    if accum_dtype == DS_ACCUM:
+        # Double-single run sums (ops/exactsum.py): exact integer mass up
+        # to ~2^48 — self-loop runs of benchmark-scale communities exceed
+        # f32's 2^24 long before they exceed this.  One f32 collapse at
+        # the end, like the host oracle's single f64 -> f32 cast.
+        from cuvite_tpu.ops import exactsum as ds
+
+        hi, lo, last = ds.ds_segment_sums_sorted(run_id, w_s)
+        run_w = (hi + lo).astype(wdt)
+    else:
+        acc = wdt if accum_dtype is None else accum_dtype
+        sums = seg.segment_sum(w_s.astype(acc), run_id,
+                               num_segments=ne_pad, sorted_ids=True)
+        run_w = jnp.take(sums, run_id).astype(wdt)
+        last = jnp.concatenate(
+            [(src_s[1:] != src_s[:-1]) | (dst_s[1:] != dst_s[:-1]),
+             jnp.ones((1,), bool)])
+
+    # Emit one row per run, at the run's LAST position (where the ds sum
+    # lives); runs are contiguous, so run order — and hence the compacted
+    # output order — is the sorted (src, dst) order either way.
+    emit = last & (src_s < nv_pad)
+    ne2 = jnp.sum(emit.astype(jnp.int32))
+    pos = jnp.cumsum(emit.astype(jnp.int32)) - 1
+    slot = jnp.where(emit, pos, ne_pad)  # non-emitted rows drop
+
+    src2 = jnp.full((ne_pad,), nv_pad, src.dtype).at[slot].set(
+        src_s, mode="drop")
+    dst2 = jnp.zeros((ne_pad,), dst.dtype).at[slot].set(dst_s, mode="drop")
+    w2 = jnp.zeros((ne_pad,), wdt).at[slot].set(run_w, mode="drop")
+    return src2, dst2, w2, dense_map, nc, ne2
+
+
+@functools.partial(jax.jit, static_argnames=("nv_pad",))
+def device_weighted_degrees(src, w, *, nv_pad: int):
+    """vDegree of a device-resident slab (padding src >= nv_pad drops)."""
+    return seg.segment_sum(w, src, num_segments=nv_pad, sorted_ids=True)
+
+
+@jax.jit
+def device_compose_labels(dense_map, labels, comm_all):
+    """Cross-phase label composition on device (main.cpp:374-403):
+    original vertex -> current dense vertex id, through this phase's
+    padded-space ``labels`` and its ``dense_map``."""
+    return jnp.take(dense_map, jnp.take(labels, comm_all))
+
+
+def shrink_slab(src, dst, w, *, new_nv_pad: int, new_ne_pad: int):
+    """Drop a compacted coarse slab to a smaller pow2 class — device ops
+    only (a prefix slice plus a padding-sentinel rewrite; real ids are
+    < nc <= new_nv_pad, so only the old nv_pad sentinels move)."""
+    s = src[:new_ne_pad]
+    s = jnp.where(s >= new_nv_pad, jnp.asarray(new_nv_pad, s.dtype), s)
+    return s, dst[:new_ne_pad], w[:new_ne_pad]
+
+
+def maybe_shrink_to_class(src, dst, w, *, nc: int, ne2: int, nv_pad: int,
+                          ne_pad: int, min_nv_pad: int = 4096,
+                          min_ne_pad: int = 16384):
+    """THE slab-class transition policy, shared by the sort-engine and
+    fused drivers (one copy, so their padding behavior cannot drift):
+    recompute the pow2 class for a coarse graph (same floors as
+    DistGraph.build's single-shard defaults, so device and host rebuilds
+    land on identical compiled-step cache keys) and shrink the slab only
+    when a strictly smaller class fits — coarsening never grows nv/ne,
+    so the class never grows.  Returns (src, dst, w, nv_pad, ne_pad)."""
+    new_nv_pad = max(next_pow2(max(nc, 1)), min_nv_pad)
+    new_ne_pad = max(next_pow2(max(ne2, 1)), min_ne_pad)
+    if new_nv_pad < nv_pad or new_ne_pad < ne_pad:
+        src, dst, w = shrink_slab(src, dst, w, new_nv_pad=new_nv_pad,
+                                  new_ne_pad=new_ne_pad)
+        return src, dst, w, new_nv_pad, new_ne_pad
+    return src, dst, w, nv_pad, ne_pad

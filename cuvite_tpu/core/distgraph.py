@@ -55,7 +55,11 @@ def balanced_parts(graph: Graph, nshards: int) -> np.ndarray:
 
 @dataclasses.dataclass
 class Shard:
-    """One device's padded edge slab plus its owned vertex range."""
+    """One device's padded edge slab plus its owned vertex range.
+
+    Slab arrays are host numpy on the ingest path; on the device-resident
+    coarsening path (:meth:`DistGraph.from_device_slab`) they are jax
+    arrays already living in device memory."""
 
     base: int       # first owned global vertex id
     bound: int      # one past last owned global vertex id
@@ -63,6 +67,25 @@ class Shard:
     dst: np.ndarray   # [ne_pad] GLOBAL tail vertex id; pad = 0
     w: np.ndarray     # [ne_pad] weight; pad = 0
     n_real_edges: int
+
+
+@dataclasses.dataclass
+class SlabMeta:
+    """Stands in for ``DistGraph.graph`` when the graph exists only as a
+    device-resident slab (no host CSR was ever built): the scalar facts
+    the drivers actually consult, and nothing that would imply O(E) host
+    data.  ``total_edge_weight_twice`` is carried through coarsening
+    unchanged — community aggregation preserves 2m exactly
+    (rebuild.cpp:430-454), which is what keeps the gain constant and the
+    modularity scale identical across phases."""
+
+    num_vertices: int
+    num_edges: int
+    policy: Policy
+    tw2: float
+
+    def total_edge_weight_twice(self) -> float:
+        return self.tw2
 
 
 @dataclasses.dataclass
@@ -77,7 +100,7 @@ class DistGraph:
     padded id space concatenate shard slices directly.
     """
 
-    graph: Graph
+    graph: Graph             # host CSR, or SlabMeta on the device path
     parts: np.ndarray        # [nshards+1] original-id partition table
     nshards: int
     nv_pad: int              # owned vertices per shard, padded
@@ -85,6 +108,7 @@ class DistGraph:
     shards: list              # list[Shard]
     old_to_pad: np.ndarray   # [nv] original global id -> padded global id
     pad_to_old: np.ndarray   # [nshards*nv_pad] padded id -> original id (or -1)
+    device_resident: bool = False  # slab arrays are jax device arrays
 
     @property
     def total_vertices(self) -> int:
@@ -230,18 +254,69 @@ class DistGraph:
             pad_to_old=pad_to_old,
         )
 
+    @staticmethod
+    def from_device_slab(
+        src, dst, w, *,
+        num_vertices: int,
+        num_edges: int,
+        nv_pad: int,
+        ne_pad: int,
+        policy: Policy,
+        total_weight_twice: float,
+    ) -> "DistGraph":
+        """Re-derive single-shard metadata around an ALREADY device-resident
+        padded slab — the output of coarsen/device.py — without a host
+        rebuild.  The O(E) arrays never leave HBM: only the O(V) id-space
+        tables (identity here: a coarse graph's vertex ids ARE the dense
+        community ids 0..nc-1) and the scalar facts live on the host.
+
+        src/dst/w: jax arrays of shape [ne_pad], same layout contract as
+        :meth:`build`'s single-shard slab (src sorted ascending, pad rows
+        src == nv_pad / w == 0).  ``total_weight_twice`` is the ORIGINAL
+        graph's 2m (invariant under coarsening)."""
+        meta = SlabMeta(num_vertices=num_vertices, num_edges=num_edges,
+                        policy=policy, tw2=float(total_weight_twice))
+        shard = Shard(base=0, bound=num_vertices, src=src, dst=dst, w=w,
+                      n_real_edges=num_edges)
+        old_to_pad = np.arange(num_vertices, dtype=np.int64)
+        pad_to_old = np.full(nv_pad, -1, dtype=np.int64)
+        pad_to_old[:num_vertices] = old_to_pad
+        return DistGraph(
+            graph=meta,
+            parts=np.asarray([0, num_vertices], dtype=np.int64),
+            nshards=1,
+            nv_pad=nv_pad,
+            ne_pad=ne_pad,
+            shards=[shard],
+            old_to_pad=old_to_pad,
+            pad_to_old=pad_to_old,
+            device_resident=True,
+        )
+
     # ---- stacked views for device placement -------------------------------
 
     def stacked_edges(self):
         """Return (src, dst, w) each of shape [nshards*ne_pad], shard-major,
-        ready to be sharded along axis 0 of a 1-D mesh."""
+        ready to be sharded along axis 0 of a 1-D mesh.  On the
+        device-resident path the single shard's jax arrays are returned
+        as-is (no host concatenate, no transfer)."""
+        if self.device_resident:
+            sh = self.shards[0]
+            return sh.src, sh.dst, sh.w
         src = np.concatenate([sh.src for sh in self.shards])
         dst = np.concatenate([sh.dst for sh in self.shards])
         w = np.concatenate([sh.w for sh in self.shards])
         return src, dst, w
 
     def padded_weighted_degrees(self) -> np.ndarray:
-        """vDegree in the padded id space (padding vertices get 0)."""
+        """vDegree in the padded id space (padding vertices get 0).  On the
+        device-resident path this is one jitted segment sum over the slab
+        in HBM (a jax array comes back, not numpy)."""
+        if self.device_resident:
+            from cuvite_tpu.coarsen.device import device_weighted_degrees
+
+            sh = self.shards[0]
+            return device_weighted_degrees(sh.src, sh.w, nv_pad=self.nv_pad)
         wd = self.graph.weighted_degrees().astype(np.float64)
         out = np.zeros(self.total_padded_vertices, dtype=np.float64)
         out[self.old_to_pad] = wd

@@ -65,6 +65,26 @@ class Tracer:
         if self.enabled:
             self.counters[name] = self.counters.get(name, 0) + value
 
+    # Stage names the drivers use, in pipeline order.  The first three are
+    # the bench record's REQUIRED per-stage fields (ISSUE 3 satellite):
+    # coarsen_s — inter-phase graph rebuild (host or device); upload_s —
+    # host->device placement of slabs/plans; iterate_s — the jitted phase
+    # loops.  Note upload runs NESTED inside the driver's plan stage on
+    # the per-phase engine path, so there plan_s CONTAINS upload_s (the
+    # fused driver's stages are disjoint).
+    CANONICAL_STAGES = ("coarsen", "upload", "iterate")
+
+    def breakdown(self) -> dict:
+        """Per-stage seconds for machine consumers (the bench JSON's
+        ``stages`` field): always carries ``<stage>_s`` for every
+        CANONICAL_STAGES entry (0.0 when the stage never ran), plus any
+        other recorded stage under the same naming."""
+        out = {k + "_s": round(self.times.get(k, 0.0), 3)
+               for k in self.CANONICAL_STAGES}
+        for k, v in sorted(self.times.items()):
+            out.setdefault(k + "_s", round(v, 3))
+        return out
+
     def teps(self) -> float:
         """Traversed edges per second: counter 'traversed_edges' over the
         'iterate' stage WALL time.  Unlike the steady-state bench metric

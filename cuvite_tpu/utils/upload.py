@@ -89,6 +89,13 @@ def to_device(x, dtype=None):
     uploads made the ~50-operand phase-loop cache key flip per run and
     per phase, recompiling up to every phase of every run — the judge's
     round-4 7x bench regression (VERDICT r4 weak #1)."""
+    if isinstance(x, jax.Array):
+        # Already device-resident (the coarsen/device.py path hands jit
+        # outputs — committed by construction — straight back to the next
+        # phase's runner): never round-trip it through numpy.
+        if dtype is not None and x.dtype != np.dtype(dtype):
+            return x.astype(dtype)
+        return x
     x = np.asarray(x)
     if dtype is not None:
         x = x.astype(dtype, copy=False)

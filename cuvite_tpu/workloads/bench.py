@@ -37,8 +37,14 @@ BASELINE_EDGES_PER_SEC_PER_CHIP = 1.0e9 / 64.0
 
 REQUIRED_RECORD_KEYS = (
     "metric", "value", "unit", "vs_baseline", "platform", "graph",
-    "modularity", "phases", "compile_guard",
+    "modularity", "phases", "compile_guard", "stages",
 )
+
+# Per-stage wall-clock fields every record must carry (schema v2, ISSUE 3):
+# the breakdown that makes the device-resident coarsening win measurable
+# per phase instead of hiding inside one wall number.  Taken from the
+# tracer of the RECORDED run (utils.trace.Tracer.breakdown).
+REQUIRED_STAGE_KEYS = ("coarsen_s", "upload_s", "iterate_s")
 
 
 class BenchCompileGuardError(RuntimeError):
@@ -101,6 +107,16 @@ def validate_record(rec: dict) -> list:
             problems.append("compile_guard must carry 'checked'")
         elif guard["checked"] and guard.get("new_compiles", -1) != 0:
             problems.append("a checked record must have new_compiles == 0")
+        stages = rec["stages"]
+        if not isinstance(stages, dict):
+            problems.append("stages must be a dict of <stage>_s seconds")
+        else:
+            for k in REQUIRED_STAGE_KEYS:
+                v = stages.get(k)
+                if not isinstance(v, (int, float)) or v < 0:
+                    problems.append(
+                        f"stages[{k!r}] must be a non-negative number, "
+                        f"got {v!r}")
     return problems
 
 
@@ -185,7 +201,7 @@ def run_bench(
     compiles anything new.
     """
     from cuvite_tpu.louvain.driver import louvain_phases
-    from cuvite_tpu.utils.trace import rss_high_water_mb
+    from cuvite_tpu.utils.trace import Tracer, rss_high_water_mb
 
     get = graph_source if callable(graph_source) else (lambda: graph_source)
     t_start = _T_PROC if t_start is None else t_start
@@ -195,11 +211,12 @@ def run_bench(
     # execution (the reference likewise excludes one-time costs from its
     # clustering-time metric, main.cpp:499-518).
     t1 = time.perf_counter()
-    res = louvain_phases(get(), engine=engine)
+    warm_tr = Tracer()
+    res = louvain_phases(get(), engine=engine, tracer=warm_tr)
     warm_wall = time.perf_counter() - t1
     elapsed = time.perf_counter() - t_start
 
-    def record(res, wall, compile_guard, all_teps=(), load=()):
+    def record(res, wall, compile_guard, all_teps=(), load=(), tr=None):
         teps, clustering_s = _one_teps(res, wall)
         best = max((teps, *all_teps))
         print(f"# Q={res.modularity:.5f} phases={len(res.phases)} "
@@ -217,6 +234,9 @@ def run_bench(
             "iterations": int(res.total_iterations),
             "rss_mb": round(rss_high_water_mb(), 1),
             "compile_guard": compile_guard,
+            # Per-stage breakdown of the RECORDED run (schema v2): where
+            # the phase-transition time goes — coarsen/upload vs iterate.
+            "stages": (tr or Tracer()).breakdown(),
         }
         if scale is not None:
             out["scale"] = scale
@@ -241,11 +261,11 @@ def run_bench(
               f"skipping the steady-state rerun", file=sys.stderr)
         return record(res, warm_wall,
                       {"checked": False, "reason": "budget"},
-                      load=[_loadavg()])
+                      load=[_loadavg()], tr=warm_tr)
     del res  # free the warm-up labels (O(nv)) before the timed runs
 
     all_teps, loads = [], [_loadavg()]
-    last_res, last_wall = None, warm_wall
+    last_res, last_wall, last_tr = None, warm_wall, warm_tr
     guard = {"checked": True, "new_compiles": 0}
     while len(all_teps) < max(1, repeats):
         elapsed = time.perf_counter() - t_start
@@ -255,15 +275,18 @@ def run_bench(
             break
         g = get()
         t1 = time.perf_counter()
+        last_tr = Tracer()
         if not all_teps:
             # THE gate: any fresh compile inside the first timed run
             # invalidates the whole measurement (VERDICT r5 weak #6).
             with _CompileWatcher() as watch:
-                last_res = louvain_phases(g, engine=engine, verbose=False)
+                last_res = louvain_phases(g, engine=engine, verbose=False,
+                                          tracer=last_tr)
             if watch.compiles:
                 raise BenchCompileGuardError(watch.compiles)
         else:
-            last_res = louvain_phases(g, engine=engine, verbose=False)
+            last_res = louvain_phases(g, engine=engine, verbose=False,
+                                      tracer=last_tr)
         last_wall = time.perf_counter() - t1
         teps, _ = _one_teps(last_res, last_wall)
         all_teps.append(teps)
@@ -272,7 +295,7 @@ def run_bench(
               f"(wall {last_wall:.1f}s, load {loads[-1]:.2f})",
               file=sys.stderr)
     return record(last_res, last_wall, guard, all_teps=all_teps,
-                  load=loads)
+                  load=loads, tr=last_tr)
 
 
 def _build_parser() -> argparse.ArgumentParser:

@@ -284,6 +284,30 @@ def _verify_checksum(digest, expected, path):
 """,
         "cuvite_tpu/workloads/registry.py",
     ),
+    (
+        "R010",
+        """
+import jax
+import numpy as np
+
+def phase_transition(src_d, labels_d, stats):
+    host_slab = jax.device_get(src_d)
+    lab = np.asarray(labels_d)
+    return host_slab, lab
+""",
+        """
+import numpy as np
+
+def build_plan(plan, comm_pad):
+    # host plan arrays: attribute access and non-device-suggestive names
+    # are out of scope by design (near-zero false positives)
+    src_np = np.asarray(plan.src)
+    comm = np.asarray(comm_pad)
+    final = np.asarray(labels_d)  # graftlint: disable=R010 — the final label gather
+    return src_np, comm, final
+""",
+        "cuvite_tpu/coarsen/fake_r010.py",
+    ),
 ]
 
 RULE_IDS = [c[0] for c in RULE_CASES]
@@ -393,6 +417,30 @@ else:
 def test_r008_else_branch_polarity(guard, fires):
     findings = run_source(R008_ELSE % guard, rel="tests/x.py")
     assert ("R008" in rules_of(findings)) == fires, (guard, findings)
+
+
+def test_r010_scope_and_name_heuristic():
+    bad = RULE_CASES[9][1]
+    # In scope under BOTH phase-transition prefixes...
+    for rel in ("cuvite_tpu/louvain/x.py", "cuvite_tpu/coarsen/x.py"):
+        hits = [f for f in run_source(bad, rel=rel) if f.rule == "R010"]
+        # jax.device_get + np.asarray(labels_d): two findings
+        assert len(hits) == 2, (rel, hits)
+    # ...and silent everywhere else (the same pulls are legitimate on
+    # ingest/eval paths where no device-resident slab exists).
+    for rel in ("cuvite_tpu/io/x.py", "cuvite_tpu/workloads/x.py",
+                "tools/x.py"):
+        assert "R010" not in rules_of(run_source(bad, rel=rel)), rel
+
+
+def test_r010_inline_disable_is_the_allowlist():
+    src = """
+import jax
+
+def finalize(labels_d):
+    return jax.device_get(labels_d)  # graftlint: disable=R010 — final label gather
+"""
+    assert run_source(src, rel="cuvite_tpu/louvain/x.py") == []
 
 
 def test_r007_scope_is_tools_only():

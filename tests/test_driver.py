@@ -72,3 +72,74 @@ def test_star_graph_collapses():
     res = louvain_phases(g)
     assert res.num_communities <= n
     assert res.modularity <= 0.5
+
+
+# ---------------------------------------------------------------------------
+# CUVITE_EXCHANGE_CUTOVER (the exchange='auto' sparse cutover, env-tunable)
+
+
+def test_exchange_cutover_env_override(monkeypatch):
+    from cuvite_tpu.louvain.driver import (
+        AUTO_SPARSE_MIN_VERTICES, exchange_cutover,
+    )
+
+    monkeypatch.delenv("CUVITE_EXCHANGE_CUTOVER", raising=False)
+    assert exchange_cutover() == AUTO_SPARSE_MIN_VERTICES
+    monkeypatch.setenv("CUVITE_EXCHANGE_CUTOVER", "1024")
+    assert exchange_cutover() == 1024
+    monkeypatch.setenv("CUVITE_EXCHANGE_CUTOVER", "0x100")
+    assert exchange_cutover() == 256
+    for bogus in ("zero", "-5", "0", ""):
+        monkeypatch.setenv("CUVITE_EXCHANGE_CUTOVER", bogus)
+        if bogus == "":
+            assert exchange_cutover() == AUTO_SPARSE_MIN_VERTICES
+        else:
+            with pytest.warns(UserWarning, match="CUVITE_EXCHANGE_CUTOVER"):
+                assert exchange_cutover() == AUTO_SPARSE_MIN_VERTICES
+
+
+def test_exchange_cutover_is_honored_by_auto(karate, monkeypatch):
+    """exchange='auto' on a mesh: with the cutover forced to 1 every phase
+    resolves to the sparse plan (observable at the ExchangePlan.build
+    chokepoint); with the default cutover (2^26) none does."""
+    from cuvite_tpu.comm.exchange import ExchangePlan
+
+    calls = []
+    orig = ExchangePlan.build
+
+    def counting(*a, **k):
+        calls.append(1)
+        return orig(*a, **k)
+
+    monkeypatch.setattr(ExchangePlan, "build", staticmethod(counting))
+    monkeypatch.setenv("CUVITE_EXCHANGE_CUTOVER", "1")
+    r_sparse = louvain_phases(karate, nshards=2, exchange="auto")
+    assert calls, "cutover=1 must route exchange='auto' to the sparse plan"
+    n_sparse = len(calls)
+    calls.clear()
+    monkeypatch.delenv("CUVITE_EXCHANGE_CUTOVER")
+    r_repl = louvain_phases(karate, nshards=2, exchange="auto")
+    assert not calls, "below the default cutover 'auto' stays replicated"
+    # Exchange choice must not change the clustering.
+    np.testing.assert_array_equal(r_sparse.communities, r_repl.communities)
+    assert n_sparse >= 1
+
+
+# ---------------------------------------------------------------------------
+# sort-engine x coloring: auto-switch to the class-capable bucketed engine
+
+
+def test_sort_coloring_auto_switches_to_bucketed(karate):
+    with pytest.warns(UserWarning, match="auto-switching"):
+        r = louvain_phases(karate, engine="sort", coloring=4)
+    r_ref = louvain_phases(karate, engine="bucketed", coloring=4)
+    np.testing.assert_array_equal(r.communities, r_ref.communities)
+    assert r.modularity == r_ref.modularity
+
+
+def test_sort_coloring_opt_out_keeps_legacy_schedule(karate, monkeypatch):
+    monkeypatch.setenv("CUVITE_KEEP_SORT_COLORING", "1")
+    with pytest.warns(UserWarning, match="legacy schedule"):
+        res = louvain_phases(karate, engine="sort", coloring=4)
+    q = modularity_oracle(karate, res.communities)
+    assert q >= 0.38

@@ -343,6 +343,10 @@ def test_bench_record_schema_and_guard_pass():
     assert rec["compile_guard"] == {"checked": True, "new_compiles": 0}
     assert rec["runs"] == 2 and len(rec["teps_runs"]) == 2
     assert rec["platform"] == "cpu" and rec["value"] > 0
+    # Schema v2: per-stage breakdown of the recorded run (ISSUE 3).
+    for k in ("coarsen_s", "upload_s", "iterate_s"):
+        assert k in rec["stages"] and rec["stages"][k] >= 0
+    assert rec["stages"]["iterate_s"] > 0  # the phase loops always run
 
 
 def test_bench_aborts_on_injected_recompile():
@@ -383,8 +387,19 @@ def test_validate_record_rejects_unchecked_nonzero_compiles():
            "unit": "traversed_edges/sec", "vs_baseline": 0.1,
            "platform": "cpu", "graph": "x", "modularity": 0.1,
            "phases": 1, "compile_guard": {"checked": True,
-                                          "new_compiles": 2}}
+                                          "new_compiles": 2},
+           "stages": {"coarsen_s": 0.0, "upload_s": 0.0,
+                      "iterate_s": 1.0}}
     assert any("new_compiles" in p for p in validate_record(rec))
+    # Schema v2: a record without the stage breakdown (or with a bogus
+    # one) is rejected.
+    old = dict(rec, compile_guard={"checked": True, "new_compiles": 0})
+    del old["stages"]
+    assert any("stages" in p for p in validate_record(old))
+    bad = dict(rec, compile_guard={"checked": True, "new_compiles": 0},
+               stages={"coarsen_s": -1.0, "upload_s": 0.0,
+                       "iterate_s": 1.0})
+    assert any("coarsen_s" in p for p in validate_record(bad))
 
 
 # ---------------------------------------------------------------------------
