@@ -1,13 +1,14 @@
-"""graftlint rule set R001..R010 (see ANALYSIS.md for the catalogue).
+"""graftlint rule set R001..R011 (see ANALYSIS.md for the catalogue).
 
 Each rule targets a hazard class this codebase has actually hit (or is
 one refactor away from hitting): host syncs inside jitted code, jit
 recompile traps, 64-bit dtype drift into the 32-bit device path,
 collective-order divergence across hosts, mutation of caller-owned
 buffers, non-exact reductions feeding modularity, unbounded child
-processes in tools, host-global side effects in test fixtures, and
-network access outside the workloads fetch path (or without checksum
-verification).
+processes in tools, host-global side effects in test fixtures, network
+access outside the workloads fetch path (or without checksum
+verification), device->host pulls in phase-transition code, and Pallas
+block shapes not derived from the static width-ladder constants.
 
 Rules are heuristic by design: they trade completeness for a near-zero
 false-positive rate on idiomatic code, and every remaining intentional
@@ -710,6 +711,48 @@ _HOST_MATERIALIZE_CALLS = {"np.asarray", "numpy.asarray",
                            "np.array", "numpy.array"}
 _DEVICE_NAME_SUFFIXES = ("_dev", "_d")
 _DEVICE_NAME_PREFIXES = ("labels",)
+
+
+@register
+class PallasLiteralBlockShape(Rule):
+    id = "R011"
+    severity = "medium"
+    title = "Pallas BlockSpec block shape with a hard-coded dimension"
+
+    # Unit dims are layout plumbing ((1, tile) vectors, (D, 1) rows), not a
+    # tile-size decision; anything else must be a NAME bound to the static
+    # width-ladder constants (DEFAULT_BUCKETS-derived D, the VMEM-budgeted
+    # tile, LANE) so a ladder retune cannot leave a kernel silently
+    # recompiling per width or overflowing VMEM with a stale literal.
+    _ALLOWED_LITERALS = (1,)
+
+    def check(self, sf):
+        if not _in_device_path(sf):
+            return
+        for node in sf.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func) or ""
+            if fname.split(".")[-1] != "BlockSpec":
+                continue
+            if not node.args:
+                continue  # memory_space-only spec: no block shape
+            shape = node.args[0]
+            if not isinstance(shape, (ast.Tuple, ast.List)):
+                continue
+            for el in shape.elts:
+                if isinstance(el, ast.Constant) \
+                        and isinstance(el.value, int) \
+                        and el.value not in self._ALLOWED_LITERALS:
+                    yield self.finding(
+                        sf, el,
+                        f"BlockSpec block dimension {el.value} is a "
+                        "hard-coded literal: block shapes must be derived "
+                        "from the static width-ladder constants "
+                        "(DEFAULT_BUCKETS widths / PALLAS_MAX_WIDTH / "
+                        "LANE / the VMEM-budgeted tile) — a stale literal "
+                        "silently recompiles per width class or blows "
+                        "VMEM when the ladder is retuned")
 
 
 @register

@@ -50,7 +50,7 @@ def build_parser() -> argparse.ArgumentParser:
                      help="per-host sharded ingest: each process range-reads "
                           "only its shards' edges (the MPI-IO per-rank "
                           "slice analog, distgraph.cpp:69-203); requires "
-                          "--file and the bucketed engine")
+                          "--file and the bucketed/pallas engines")
     src.add_argument("--generate", "-n", type=int, metavar="NV",
                      help="generate an in-memory RGG with NV vertices")
     src.add_argument("--rmat", type=int, metavar="SCALE",
@@ -160,9 +160,9 @@ def validate(args) -> None:
     if args.dist_ingest:
         if not args.file:
             raise SystemExit("--dist-ingest requires --file")
-        if args.engine not in ("auto", "bucketed"):
-            raise SystemExit("--dist-ingest supports only the bucketed "
-                             "engine")
+        if args.engine not in ("auto", "bucketed", "pallas"):
+            raise SystemExit("--dist-ingest supports only the "
+                             "bucketed/pallas engines")
         if (args.coloring or args.vertex_ordering or args.checkpoint_dir
                 or args.write_graph):
             raise SystemExit("--dist-ingest is incompatible with "

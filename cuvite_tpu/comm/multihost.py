@@ -38,6 +38,28 @@ import numpy as np
 _INITIALIZED = False
 
 
+def _enable_cpu_collectives() -> None:
+    """Switch the CPU backend's cross-process collectives on (gloo).
+
+    This image's jax (0.4.x) defaults ``jax_cpu_collectives_implementation``
+    to ``'none'``, so a multi-process CPU run fails its FIRST collective with
+    "Multiprocess computations aren't implemented on the CPU backend" — the
+    historical tier-1 multihost failures.  Newer jax releases default to
+    gloo and (eventually) drop the flag, hence the defensive lookup.  An
+    explicit JAX_CPU_COLLECTIVES_IMPLEMENTATION (e.g. 'mpi') always wins;
+    TPU runs are unaffected (the flag only configures the CPU client).
+    """
+    if os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION"):
+        return
+    try:
+        holder = jax.config._value_holders[
+            "jax_cpu_collectives_implementation"]
+    except (AttributeError, KeyError):
+        return  # flag absent: this jax already defaults to a working impl
+    if holder.value in (None, "none"):
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+
 def initialize(coordinator: str | None = None,
                num_processes: int | None = None,
                process_id: int | None = None,
@@ -57,6 +79,9 @@ def initialize(coordinator: str | None = None,
         num_processes = int(os.environ["CUVITE_NUM_PROCESSES"])
     if process_id is None and os.environ.get("CUVITE_PROCESS_ID"):
         process_id = int(os.environ["CUVITE_PROCESS_ID"])
+    # Must happen before the backend exists: the collectives implementation
+    # is baked into the CPU client at creation.
+    _enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,

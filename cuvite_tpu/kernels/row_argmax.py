@@ -7,7 +7,7 @@ counterpart of the reference GPU's thread-per-vertex dedup/argmax kernels
 computeMaxIndex, :641-876).  The XLA fallback (`_row_argmax` in
 cuvite_tpu/louvain/bucketed.py) materializes the [rows, D] aggregation
 intermediates in HBM; this kernel keeps the whole per-tile computation in
-VMEM and writes only the three per-row result vectors.
+VMEM and writes only the per-row result vectors.
 
 Layout: the bucket is TRANSPOSED to [D, N] so the lane dimension runs
 across bucket rows (N = padded row count, a multiple of the 128-lane tile)
@@ -24,6 +24,16 @@ dimension.  Per candidate slot j:
 
 plus counter0 = sum of weights into the current community (incl. self
 edges), which the caller turns into eix for the next stage.
+
+SPMD: the kernel itself is shard-oblivious — the sharded bucketed step
+(louvain/bucketed.py) calls it INSIDE its shard_map body on each shard's
+[D, N] block.  The sparse ghost exchange additionally needs the SIZE of
+the winning community for the singleton-swap guard; ``szT`` (the per-slot
+attached community size, same layout as ``ayT``) switches the kernel to a
+4-output form that tracks the winning slot's size through the running
+argmax.  Every slot holding a community carries that community's size, so
+the tracked value equals the XLA path's min-over-chosen-slots — bit-equal
+by construction.
 """
 
 from __future__ import annotations
@@ -41,17 +51,23 @@ DEFAULT_TILE_N = 512
 # lax.fori_loop (bounding compile time; identical arithmetic).  The
 # unrolled form lets Mosaic schedule the small widths tightest.
 UNROLL_MAX_WIDTH = 32
-# Per-tile VMEM budget for the [D, T] operand blocks (c/w/ay + outputs),
-# used to shrink the row tile for wide classes: 3 f32 blocks of
-# D x tile_n must fit comfortably under ~16 MB v5e VMEM.
+# Per-tile VMEM budget for the [D, T] operand blocks (c/w/ay (+size) +
+# outputs), used to shrink the row tile for wide classes: the f32/int32
+# blocks of D x tile_n must fit comfortably under ~16 MB v5e VMEM.
 VMEM_BUDGET_BYTES = 6 << 20
 
 
 def _kernel(const_ref, cT_ref, wT_ref, ayT_ref, curr_ref, vdeg_ref, sl_ref,
-            ax_ref, bc_ref, bg_ref, c0_ref, *, sentinel: int, width: int):
+            ax_ref, *refs, sentinel: int, width: int, with_size: bool):
+    if with_size:
+        szT_ref, bc_ref, bg_ref, c0_ref, bs_ref = refs
+    else:
+        bc_ref, bg_ref, c0_ref = refs
+        szT_ref = bs_ref = None
     c = cT_ref[:]          # [D, T] int32 neighbor communities
     w = wT_ref[:]          # [D, T] f32 edge weights
     ay = ayT_ref[:]        # [D, T] f32 comm_deg of each candidate
+    sz = szT_ref[:] if with_size else None   # [D, T] int32 candidate size
     curr = curr_ref[:]     # [1, T] int32 current community
     vdeg = vdeg_ref[:]     # [1, T] f32 weighted degree k_i
     sl = sl_ref[:]         # [1, T] f32 self-loop weight of the vertex
@@ -70,15 +86,19 @@ def _kernel(const_ref, cT_ref, wT_ref, ayT_ref, curr_ref, vdeg_ref, sl_ref,
     neg_inf = jnp.full(curr.shape, -jnp.inf, dtype=wdt)
     bg0 = neg_inf
     bc0 = jnp.full(curr.shape, sentinel, dtype=c.dtype)
+    bs0 = jnp.full(curr.shape, sentinel, dtype=c.dtype) if with_size else None
     two_vdeg = 2.0 * vdeg
 
-    def step_j(cj, ayj, eq, dup_j, bc, bg):
+    def step_j(cj, ayj, szj, eq, dup_j, bc, bg, bs):
         """One candidate slot: aggregate duplicates, gain, running argmax.
         Shared by the unrolled (static j) and fori_loop (traced j) forms —
         identical arithmetic, so the two are bit-identical.  Operand order
         matches the XLA paths exactly (bucketed.py `_row_argmax`:
         ((2*vdeg)*(ay-ax))*const) so engines agree bit-for-bit even on
-        non-dyadic constants where f32 association matters."""
+        non-dyadic constants where f32 association matters.  ``bs`` rides
+        the same better/tie updates as ``bc``: any slot of the winning
+        community carries the same attached size, so tracking the slot
+        that wins the (gain, smaller-id) order IS the XLA min-over-chosen."""
         wagg_j = jnp.sum(jnp.where(eq, w, zero), axis=0, keepdims=True)
         valid_j = (~dup_j) & (cj != curr) if dup_j is not None \
             else (cj != curr)
@@ -86,18 +106,23 @@ def _kernel(const_ref, cT_ref, wT_ref, ayT_ref, curr_ref, vdeg_ref, sl_ref,
         gain_j = jnp.where(valid_j, gain_j, neg_inf)
         better = gain_j > bg
         tie = valid_j & (gain_j == bg)
+        if bs is not None:
+            take = better | (tie & (cj < bc))
+            bs = jnp.where(take, szj, bs)
         bc = jnp.where(better, cj, jnp.where(tie, jnp.minimum(bc, cj), bc))
         bg = jnp.maximum(bg, gain_j)
-        return bc, bg
+        return bc, bg, bs
 
     if width <= UNROLL_MAX_WIDTH:
-        bc, bg = bc0, bg0
+        bc, bg, bs = bc0, bg0, bs0
         for j in range(width):
             cj = c[j : j + 1, :]
             eq = c == cj
             dup_j = (jnp.any(eq[:j, :], axis=0, keepdims=True)
                      if j > 0 else None)
-            bc, bg = step_j(cj, ay[j : j + 1, :], eq, dup_j, bc, bg)
+            szj = sz[j : j + 1, :] if with_size else None
+            bc, bg, bs = step_j(cj, ay[j : j + 1, :], szj, eq, dup_j,
+                                bc, bg, bs)
     else:
         # Wide classes: loop over candidate slots with dynamic sublane
         # slices (compile time O(1) in width).  The duplicate-leader test
@@ -105,17 +130,33 @@ def _kernel(const_ref, cT_ref, wT_ref, ayT_ref, curr_ref, vdeg_ref, sl_ref,
         D, T = c.shape
         row_idx = jax.lax.broadcasted_iota(jnp.int32, (D, T), 0)
 
-        def body(j, carry):
-            bc, bg = carry
-            cj = jax.lax.dynamic_slice_in_dim(c, j, 1, axis=0)
-            ayj = jax.lax.dynamic_slice_in_dim(ay, j, 1, axis=0)
-            eq = c == cj
-            dup_j = jnp.any(eq & (row_idx < j), axis=0, keepdims=True)
-            return step_j(cj, ayj, eq, dup_j, bc, bg)
+        if with_size:
+            def body(j, carry):
+                bc, bg, bs = carry
+                cj = jax.lax.dynamic_slice_in_dim(c, j, 1, axis=0)
+                ayj = jax.lax.dynamic_slice_in_dim(ay, j, 1, axis=0)
+                szj = jax.lax.dynamic_slice_in_dim(sz, j, 1, axis=0)
+                eq = c == cj
+                dup_j = jnp.any(eq & (row_idx < j), axis=0, keepdims=True)
+                return step_j(cj, ayj, szj, eq, dup_j, bc, bg, bs)
 
-        bc, bg = jax.lax.fori_loop(0, width, body, (bc0, bg0))
+            bc, bg, bs = jax.lax.fori_loop(0, width, body, (bc0, bg0, bs0))
+        else:
+            def body(j, carry):
+                bc, bg = carry
+                cj = jax.lax.dynamic_slice_in_dim(c, j, 1, axis=0)
+                ayj = jax.lax.dynamic_slice_in_dim(ay, j, 1, axis=0)
+                eq = c == cj
+                dup_j = jnp.any(eq & (row_idx < j), axis=0, keepdims=True)
+                bc, bg, _ = step_j(cj, ayj, None, eq, dup_j, bc, bg, None)
+                return bc, bg
+
+            bc, bg = jax.lax.fori_loop(0, width, body, (bc0, bg0))
+            bs = None
     bc_ref[:] = bc
     bg_ref[:] = bg
+    if with_size:
+        bs_ref[:] = bs
 
 
 @functools.partial(
@@ -123,7 +164,7 @@ def _kernel(const_ref, cT_ref, wT_ref, ayT_ref, curr_ref, vdeg_ref, sl_ref,
     static_argnames=("sentinel", "tile_n", "interpret"),
 )
 def row_argmax_pallas(cT, wT, ayT, curr, vdeg, sl, ax, constant, *,
-                      sentinel: int, tile_n: int = DEFAULT_TILE_N,
+                      szT=None, sentinel: int, tile_n: int = DEFAULT_TILE_N,
                       interpret: bool = False):
     """Run the bucket kernel.
 
@@ -131,15 +172,18 @@ def row_argmax_pallas(cT, wT, ayT, curr, vdeg, sl, ax, constant, *,
     (sl = per-vertex self-loop weight); constant: scalar.  N must be a
     multiple of the row tile (bucket row counts are padded to powers of
     two >= 128 by the runner for this path).  The tile shrinks below
-    ``tile_n`` for wide D so the three [D, tile] f32 operand blocks stay
-    inside the VMEM budget.  Returns
-    (best_c [N] int, best_gain [N], counter0 [N]).
+    ``tile_n`` for wide D so the [D, tile] operand blocks stay inside
+    the VMEM budget.  Returns (best_c [N] int, best_gain [N],
+    counter0 [N]); with ``szT`` (the [D, N] attached community-size
+    matrix of the sparse exchange) additionally best_size [N] int.
     """
     D, N = cT.shape
+    with_size = szT is not None
+    n_mats = 4 if with_size else 3
     tile = min(tile_n, N)
-    # Wide classes: bound 3 * D * tile * 4B by the VMEM budget (pow2
+    # Wide classes: bound n_mats * D * tile * 4B by the VMEM budget (pow2
     # shrink keeps N % tile == 0 — both are powers of two >= 128).
-    while tile > LANE and 3 * D * tile * 4 > VMEM_BUDGET_BYTES:
+    while tile > LANE and n_mats * D * tile * 4 > VMEM_BUDGET_BYTES:
         tile //= 2
     assert N % tile == 0 and tile % LANE == 0, (N, tile)
     grid = (N // tile,)
@@ -153,22 +197,35 @@ def row_argmax_pallas(cT, wT, ayT, curr, vdeg, sl, ax, constant, *,
         jax.ShapeDtypeStruct((1, N), wT.dtype),
         jax.ShapeDtypeStruct((1, N), wT.dtype),
     )
-    kernel = functools.partial(_kernel, sentinel=sentinel, width=D)
-    bc, bg, c0 = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            mat_spec, mat_spec, mat_spec,
-            vec_spec, vec_spec, vec_spec, vec_spec,
-        ],
-        out_specs=(vec_spec, vec_spec, vec_spec),
-        out_shape=out_shapes,
-        interpret=interpret,
-    )(
+    out_specs = (vec_spec, vec_spec, vec_spec)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        mat_spec, mat_spec, mat_spec,
+        vec_spec, vec_spec, vec_spec, vec_spec,
+    ]
+    operands = [
         jnp.reshape(constant, (1,)).astype(wT.dtype),
         cT, wT, ayT,
         curr.reshape(1, N), vdeg.reshape(1, N), sl.reshape(1, N),
         ax.reshape(1, N),
-    )
+    ]
+    if with_size:
+        in_specs.append(mat_spec)
+        operands.append(szT)
+        out_shapes = out_shapes + (jax.ShapeDtypeStruct((1, N), cT.dtype),)
+        out_specs = out_specs + (vec_spec,)
+    kernel = functools.partial(_kernel, sentinel=sentinel, width=D,
+                               with_size=with_size)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        interpret=interpret,
+    )(*operands)
+    if with_size:
+        bc, bg, c0, bs = out
+        return bc.reshape(N), bg.reshape(N), c0.reshape(N), bs.reshape(N)
+    bc, bg, c0 = out
     return bc.reshape(N), bg.reshape(N), c0.reshape(N)

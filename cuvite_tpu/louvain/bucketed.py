@@ -335,11 +335,22 @@ class StackedPlan:
     self_loop: np.ndarray    # [S*nv_pad]
     perm: np.ndarray         # [S*nv_pad] per-shard assembly permutation
     unit_weights: np.ndarray  # [n_buckets] bool: w is {0,1} on EVERY host
+    # Kernel routing (engine='pallas' on a mesh): per kept bucket, True if
+    # its width class is laid out for the Pallas row kernel (row count
+    # padded to >= LANE so the per-shard [D, Nb] block tiles cleanly).
+    pallas_flags: tuple = ()
+    # Per-width real (directed) edge counts, [len(widths) + 1] with the
+    # trailing slot the heavy residual — allreduced across hosts under
+    # per-host ingest.  Only populated when ``pallas_widths`` was given
+    # (coverage accounting costs one O(E) bincount per shard).
+    width_edges: np.ndarray | None = None
 
 
 def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
                         exchange_plan=None, class_of=None,
-                        class_id: int = -1) -> StackedPlan:
+                        class_id: int = -1,
+                        pallas_widths: tuple = (),
+                        count_width_edges: bool = False) -> StackedPlan:
     """Build one BucketPlan per shard of ``dg`` and pad them to common
     shapes.  A width class appears iff some shard has vertices in it; shards
     without rows in a kept class contribute all-padding rows.
@@ -360,7 +371,17 @@ def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
     restricts each shard's plan to the vertices of one color class (other
     rows masked to padding) — the SPMD analog of the single-shard
     class-restricted plans (the reference sweeps only the class's vertices
-    on every rank, /root/reference/louvain.cpp:862-901)."""
+    on every rank, /root/reference/louvain.cpp:862-901).
+
+    ``pallas_widths`` (engine='pallas' on a mesh): width classes to lay
+    out for the Pallas row kernel — their COMMON row counts are padded up
+    to >= 128 (the kernel's lane tile; counts are pow2 already, so this
+    only lifts the sub-128 classes) and flagged in ``pallas_flags``; the
+    runner transposes those classes to [S*D, Nb] at placement.  Also
+    triggers the per-width edge accounting (``width_edges``) behind the
+    engine's kernel-coverage report; ``count_width_edges`` forces that
+    accounting even when no width qualifies (a CUVITE_PALLAS_MAX tuned
+    below the smallest bucket width must still report ITS coverage: 0)."""
     nshards = dg.nshards
     nvl = dg.nv_pad
     local_only = getattr(dg, "local_only", False)
@@ -413,11 +434,41 @@ def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
         from cuvite_tpu.comm.multihost import allreduce_max_host
 
         shape_req = allreduce_max_host(shape_req)
+    width_edges = None
+    if pallas_widths or count_width_edges:
+        # Kernel-coverage accounting: real directed edges per width class
+        # (+ heavy residual).  One O(E) bincount per local shard, summed
+        # across hosts — deterministic, so every process reports the same
+        # coverage.
+        widths_arr = np.asarray(widths, dtype=np.int64)
+        width_edges = np.zeros(len(widths) + 1, dtype=np.int64)
+        for s in sids:
+            ms = _mask_src(s)
+            deg = np.bincount(ms[ms < nvl], minlength=nvl)
+            heavy_m = deg > widths_arr[-1]
+            in_b = (deg > 0) & ~heavy_m
+            cls = np.searchsorted(widths_arr, deg[in_b], side="left")
+            width_edges[: len(widths)] += np.bincount(
+                cls, weights=deg[in_b], minlength=len(widths)
+            ).astype(np.int64)
+            width_edges[-1] += int(deg[heavy_m].sum())
+        if local_only:
+            from cuvite_tpu.comm.multihost import allreduce_sum_host
+
+            width_edges = np.asarray(allreduce_sum_host(width_edges))
     stacked_buckets = []
+    pallas_flags = []
     for wi, width in enumerate(widths):
         nb = int(shape_req[wi])
         if nb == 0:
             continue
+        if width in pallas_widths:
+            # The kernel's row dimension must be a multiple of its 128-lane
+            # tile; counts are pow2 (see BucketPlan.build), so only the
+            # sub-128 classes grow.  max keeps every process's agreed
+            # shape_req deterministic.
+            nb = max(nb, 128)
+        pallas_flags.append(width in pallas_widths)
         verts = np.full((n_rows, nb), nvl, dtype=np.int64)
         dmat = np.zeros((n_rows, nb, width), dtype=plans[0].heavy_dst.dtype)
         wmat = np.zeros((n_rows, nb, width), dtype=plans[0].heavy_w.dtype)
@@ -465,6 +516,8 @@ def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
         self_loop=self_loop,
         perm=perm.reshape(-1),
         unit_weights=unit.astype(bool),
+        pallas_flags=tuple(pallas_flags),
+        width_edges=width_edges,
     )
 
 
@@ -792,7 +845,12 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
     ``pallas_flags`` (one bool per bucket) routes flagged degree classes
     through the Pallas row-argmax kernel (cuvite_tpu/kernels/row_argmax.py);
     those buckets' dst/w matrices must be stored TRANSPOSED [D, Nb] with Nb
-    a multiple of 128 (the runner's ``engine='pallas'`` upload does this).
+    a multiple of 128 (the runner's ``engine='pallas'`` upload does this,
+    single-shard and SPMD alike — on a mesh the kernel runs INSIDE the
+    shard_map body on each shard's block, under either exchange: the
+    replicated mode feeds it the psum'd community-degree table, the sparse
+    mode the vertex-attached cdeg/csize extended-local tables, with the
+    winning community's size tracked in-kernel for the singleton guard).
 
     With ``axis_name`` the function runs SPMD inside shard_map: ``comm`` /
     ``vdeg`` / ``self_loop`` are this shard's slices.  Two exchange modes
@@ -824,8 +882,6 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
         from cuvite_tpu.comm.exchange import sparse_env, sparse_modularity
 
         assert axis_name is not None, "sparse exchange requires a mesh axis"
-        assert not any(pallas_flags or ()), \
-            "pallas buckets are single-shard only"
         env = sparse_env(comm, vdeg, sparse_plan[0], sparse_plan[1],
                          axis_name, nshards=nshards, budget=budget,
                          info=info_comm)
@@ -881,19 +937,36 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
         safe_v = jnp.minimum(verts, nv_local - 1)
         curr = jnp.take(comm, safe_v)
         if is_pallas[i]:
+            # Kernel classes arrive TRANSPOSED [D, Nb]; the gathers below
+            # stay index-shaped, so the community/ay/size matrices come out
+            # [D, Nb] too.  Works identically single-shard and inside the
+            # shard_map body: replicated mode looks candidate info up in
+            # the psum'd full tables, sparse mode reads the values ATTACHED
+            # to the referenced vertex (extended-local dst indices) and the
+            # kernel additionally tracks the winning community's size for
+            # the singleton guard.
             from cuvite_tpu.kernels.row_argmax import row_argmax_pallas
 
             if w_mat.dtype != wdt:   # uint8-compressed unit weights
                 w_mat = w_mat.astype(wdt)
             cmat_t = jnp.take(comm_ref, dst_mat)   # [D, Nb]
             vdeg_v = jnp.take(vdeg, safe_v)
-            bc, bg, c0_rows = row_argmax_pallas(
-                cmat_t, w_mat, jnp.take(comm_deg, cmat_t),
+            ayT = (jnp.take(env.cdeg_ext, dst_mat) if use_sparse
+                   else jnp.take(comm_deg, cmat_t))
+            szT = jnp.take(env.csize_ext, dst_mat) if use_sparse else None
+            out = row_argmax_pallas(
+                cmat_t, w_mat, ayT,
                 curr, vdeg_v, jnp.take(self_loop, safe_v),
-                jnp.take(comm_deg, curr) - vdeg_v, constant,
+                own_deg(safe_v) - vdeg_v, constant, szT=szT,
                 sentinel=sentinel, interpret=pallas_interpret,
             )
-            parts.append((verts, bc.astype(vdt), bg, c0_rows, None))
+            if use_sparse:
+                bc, bg, c0_rows, bs = out
+                parts.append((verts, bc.astype(vdt), bg, c0_rows,
+                              bs.astype(vdt)))
+            else:
+                bc, bg, c0_rows = out
+                parts.append((verts, bc.astype(vdt), bg, c0_rows, None))
             continue
         vdeg_v = jnp.take(vdeg, safe_v)
         res = _rows_chunked(w_mat, dst_mat,
@@ -1089,7 +1162,8 @@ def make_sharded_bucketed_mod(mesh, axis_name: str, n_buckets: int,
 
 def make_sharded_bucketed_step(mesh, axis_name: str, n_buckets: int,
                                nv_total: int, sentinel: int,
-                               accum_dtype=None, sparse=None):
+                               accum_dtype=None, sparse=None,
+                               pallas_flags=(), pallas_interpret=False):
     """Jit the bucketed sweep as a shard_map over ``axis_name``: bucket
     matrices, heavy slab and vertex state sharded along axis 0, modularity
     and move count replicated.
@@ -1098,7 +1172,14 @@ def make_sharded_bucketed_step(mesh, axis_name: str, n_buckets: int,
     ``(nshards, budget)`` to run the sparse ghost exchange — the step then
     takes two trailing plan arrays (send_idx stacked [S*S, B] and ghost_sel
     stacked [S*G], both sharded along axis 0).  The 4th output is the
-    replicated budget-overflow flag (constant False without sparse)."""
+    replicated budget-overflow flag (constant False without sparse).
+
+    ``pallas_flags`` (one bool per bucket, static): flagged classes run the
+    Pallas row-argmax kernel inside the shard_map body — their stacked
+    dst/w matrices must be placed TRANSPOSED [S*D, Nb] (still sharded
+    along axis 0, so each shard's block is the kernel's [D, Nb] layout);
+    see StackedPlan.pallas_flags.  ``pallas_interpret`` runs the kernel in
+    interpret mode (non-TPU backends)."""
     bspec = tuple((P(axis_name), P(axis_name), P(axis_name))
                   for _ in range(n_buckets))
     hspec = (P(axis_name), P(axis_name), P(axis_name))
@@ -1124,6 +1205,7 @@ def make_sharded_bucketed_step(mesh, axis_name: str, n_buckets: int,
             bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant,
             nv_total=nv_total, sentinel=sentinel, accum_dtype=accum_dtype,
             axis_name=axis_name,
+            pallas_flags=pallas_flags, pallas_interpret=pallas_interpret,
             sparse_plan=plan if plan else None,
             nshards=nshards, budget=budget,
             assemble_perm=perm,

@@ -45,6 +45,7 @@ from cuvite_tpu.core.types import (
     TERMINATION_PHASE_COUNT,
 )
 from cuvite_tpu.louvain.bucketed import (
+    DEFAULT_BUCKETS,
     PALLAS_MAX_WIDTH,
     BucketPlan,
     bucketed_step,
@@ -87,6 +88,13 @@ class LouvainResult:
     phases: list
     total_iterations: int
     total_seconds: float
+    # engine='pallas' kernel-coverage accounting (None on other engines):
+    # fraction of TRAVERSED edges (edge mass x iterations, summed over
+    # phases) that ran through the Pallas row kernel, and the per-width
+    # traversed-edge counts behind it ({width: edges}, width 0 = the
+    # heavy class, kernelized widths flagged by workloads/bench.py).
+    pallas_coverage: float | None = None
+    pallas_width_hits: dict | None = None
 
     @property
     def num_communities(self) -> int:
@@ -369,7 +377,10 @@ class PhaseRunner:
 
     ``engine``: 'sort' — the edge-slab sort/segment step; 'bucketed' — the
     degree-bucketed engine, the analog of the reference GPU's degree-class
-    kernels.  Both run single-shard or SPMD over a mesh.
+    kernels; 'pallas' — bucketed with the <= PALLAS_MAX_WIDTH classes
+    routed through the row-argmax kernel (single-shard AND inside the
+    shard_map body on a mesh, both exchanges).  All run single-shard or
+    SPMD over a mesh.
     """
 
     def __init__(self, dg: DistGraph, mesh=None, engine: str = "sort",
@@ -418,21 +429,29 @@ class PhaseRunner:
                           max(dg.graph.num_edges, nv_total))
         self.accum_name = adt
         multi = mesh is not None and int(np.prod(mesh.devices.shape)) > 1
-        if engine == "pallas" and multi:
-            # The Pallas upload layout is single-shard for now; the SPMD
-            # path keeps the XLA bucketed step.  Warn so a benchmark of
-            # --engine pallas on a mesh is not misattributed.
-            warnings.warn(
-                "engine='pallas' is single-shard only; running the "
-                "'bucketed' engine on this mesh instead", stacklevel=2)
-            engine = "bucketed"
-        if engine == "bucketed" and multi:
+        if engine in ("bucketed", "pallas") and multi:
             # SPMD bucketed path: per-shard plans padded to common shapes,
             # sharded along the mesh.  Default exchange is the sparse ghost
             # plan (comm volume O(owned + ghosts) per iteration); exchange=
             # 'replicated' keeps the all_gather/psum formulation.
+            # engine='pallas' additionally lays the <= PALLAS_MAX_WIDTH
+            # classes out transposed and runs them through the row-argmax
+            # kernel INSIDE the shard_map body (both exchanges) — the SPMD
+            # analog of the reference's per-rank device kernels
+            # (/root/reference/louvain.cpp:591-754).  With a color/ordering
+            # schedule the iteration runs the per-class plans only (the
+            # main step is never swept), so the main plan keeps the XLA
+            # layout there — exactly the single-shard pallas contract,
+            # where class plans are XLA too.
             sentinel = int(np.iinfo(vdt).max)
             use_sparse = exchange == "sparse"
+            use_pallas = (engine == "pallas"
+                          and not (color_local is not None
+                                   and n_color_classes > 0))
+            pallas_widths = tuple(
+                w for w in DEFAULT_BUCKETS
+                if w <= PALLAS_MAX_WIDTH) if use_pallas else ()
+            interp = jax.default_backend() != "tpu"
             adt_np = adt  # static accum tag (dtype name or 'ds32')
             S = dg.nshards
             local_only = getattr(dg, "local_only", False)
@@ -465,7 +484,9 @@ class PhaseRunner:
                     budget = max(128, dg.nv_pad // 4)
                 budget = min(int(budget), dg.nv_pad)
                 self.budget = budget
-                plan = build_stacked_plans(dg, exchange_plan=xplan)
+                plan = build_stacked_plans(dg, exchange_plan=xplan,
+                                           pallas_widths=pallas_widths,
+                                           count_width_edges=use_pallas)
                 self._send_idx = _place(
                     xplan.send_idx.reshape(S_rows * S, xplan.block))
                 self._ghost_sel = _place(
@@ -474,31 +495,62 @@ class PhaseRunner:
                 key = ("bucketed-sparse",
                        tuple(d.id for d in mesh.devices.flat),
                        len(plan.buckets), nv_total, sentinel, adt_np,
-                       budget)
+                       budget, plan.pallas_flags, interp)
             else:
-                plan = build_stacked_plans(dg)
+                plan = build_stacked_plans(dg, pallas_widths=pallas_widths,
+                                           count_width_edges=use_pallas)
                 sparse_cfg = None
                 key = ("bucketed", tuple(d.id for d in mesh.devices.flat),
-                       len(plan.buckets), nv_total, sentinel, adt_np)
-            buckets = tuple(
-                (_place(v.astype(vdt)),
-                 _place(d.astype(vdt)),
-                 # dtype agreed across hosts via the plan's allreduced
-                 # unit-weight flags (NOT a per-process decision).
-                 _place(ww.astype(np.uint8 if plan.unit_weights[i] else wdt)))
-                for i, (v, d, ww) in enumerate(plan.buckets)
-            )
+                       len(plan.buckets), nv_total, sentinel, adt_np,
+                       plan.pallas_flags, interp)
+            flags = plan.pallas_flags or (False,) * len(plan.buckets)
+
+            def _tpose(m, nb):
+                # Kernel-class layout: [S_rows*Nb, D] -> [S_rows*D, Nb], so
+                # the axis-0 sharding hands each shard the [D, Nb] block
+                # the row kernel consumes directly (no per-iteration
+                # transpose on device).
+                rows = m.shape[0] // nb
+                return np.ascontiguousarray(
+                    m.reshape(rows, nb, m.shape[1]).transpose(0, 2, 1)
+                ).reshape(rows * m.shape[1], nb)
+
+            buckets = []
+            for i, (v, d, ww) in enumerate(plan.buckets):
+                # dtype agreed across hosts via the plan's allreduced
+                # unit-weight flags (NOT a per-process decision).
+                w8 = np.uint8 if plan.unit_weights[i] else wdt
+                if flags[i]:
+                    nb = v.shape[0] // S_rows
+                    buckets.append((
+                        _place(v.astype(vdt)),
+                        _place(_tpose(d.astype(vdt), nb)),
+                        _place(_tpose(ww.astype(w8), nb)),
+                    ))
+                else:
+                    buckets.append((_place(v.astype(vdt)),
+                                    _place(d.astype(vdt)),
+                                    _place(ww.astype(w8))))
+            buckets = tuple(buckets)
             heavy = tuple(
                 _place(a.astype(t))
                 for a, t in zip(plan.heavy, (vdt, vdt, wdt))
             )
             self_loop = _place(plan.self_loop.astype(wdt))
             perm_dev = _place(plan.perm)
+            if use_pallas:
+                self._record_pallas_coverage([
+                    (w, int(plan.width_edges[k]), w <= PALLAS_MAX_WIDTH)
+                    for k, w in enumerate(DEFAULT_BUCKETS)
+                    if plan.width_edges[k]
+                ] + ([(0, int(plan.width_edges[-1]), False)]
+                     if plan.width_edges[-1] else []))
             step_fn = _STEP_CACHE.get(key)
             if step_fn is None:
                 step_fn = make_sharded_bucketed_step(
                     mesh, VERTEX_AXIS, len(buckets), nv_total, sentinel,
                     accum_dtype=adt_np, sparse=sparse_cfg,
+                    pallas_flags=flags, pallas_interpret=interp,
                 )
                 _STEP_CACHE[key] = step_fn
 
@@ -580,7 +632,14 @@ class PhaseRunner:
                 nv_local=dg.nv_pad, base=0,
             )
             sentinel = int(np.iinfo(vdt).max)
-            use_pallas = engine == "pallas"
+            # With a coloring/ordering schedule the iteration sweeps the
+            # per-class plans (XLA) and the mod pass only — the main plan
+            # is never executed, so kernelizing it would waste the
+            # transposed upload AND report a kernel coverage no sweep ever
+            # ran (same exclusion as the SPMD branch above).
+            use_pallas = (engine == "pallas"
+                          and not (color_local is not None
+                                   and n_color_classes > 0))
             if use_pallas:
                 # Per-bucket kernel-coverage accounting (VERDICT r3 weak
                 # #4: a pallas bench must say how much of the edge mass the
@@ -631,17 +690,7 @@ class PhaseRunner:
                 n_heavy = int(deg_all.sum()) - sum(c[1] for c in cov)
                 if n_heavy:
                     cov.append((0, n_heavy, False))  # width 0 = heavy class
-                total = max(sum(c[1] for c in cov), 1)
-                kernelized = sum(c[1] for c in cov if c[2])
-                self.pallas_coverage = kernelized / total
-                self.pallas_cov_detail = cov
-                if self.pallas_coverage < 0.5:
-                    warnings.warn(
-                        f"engine='pallas': only "
-                        f"{100 * self.pallas_coverage:.0f}% of edges are in "
-                        f"kernel-covered degree classes (<= "
-                        f"{PALLAS_MAX_WIDTH}); the rest run the XLA paths",
-                        stacklevel=2)
+                self._record_pallas_coverage(cov)
             interp = jax.default_backend() != "tpu"
             heavy = (_up(plan.heavy_src, vdt),
                      _up(plan.heavy_dst, vdt),
@@ -693,14 +742,10 @@ class PhaseRunner:
                           _up(pc.heavy_w, wdt))
                     self._class_plans.append(
                         (bk, hv, _up(pc.self_loop, wdt)))
-                # non-pallas full plan for the per-iteration modularity pass
-                mod_buckets = tuple(
-                    (_up(b.verts, vdt),
-                     _up(b.dst, vdt),
-                     _up(b.w, wdt))
-                    for b in plan.buckets
-                ) if use_pallas else buckets
-                self._mod_args = (mod_buckets, heavy, self_loop)
+                # Class schedules force use_pallas off (above), so the full
+                # plan's buckets are already in the XLA layout the
+                # modularity pass needs.
+                self._mod_args = (buckets, heavy, self_loop)
                 self._nv_total = nv_total
                 self._sentinel = sentinel
                 self._adt = adt_np
@@ -751,6 +796,25 @@ class PhaseRunner:
             # Bucket matrices replaced the slab; at benchmark scale the
             # host slab is tens of GB of dead weight from here on.
             dg.release_slabs()
+
+    def _record_pallas_coverage(self, cov) -> None:
+        """Per-width kernel-coverage accounting (VERDICT r3 weak #4): a
+        pallas bench must say how much of the edge mass the kernel actually
+        covers vs the XLA paths.  ``cov`` is a list of (width, n_edges,
+        kernelized) with width 0 standing for the heavy class; shared by
+        the single-shard and SPMD upload paths so the report means the
+        same thing on any mesh."""
+        total = max(sum(c[1] for c in cov), 1)
+        kernelized = sum(c[1] for c in cov if c[2])
+        self.pallas_coverage = kernelized / total
+        self.pallas_cov_detail = cov
+        if self.pallas_coverage < 0.5:
+            warnings.warn(
+                f"engine='pallas': only "
+                f"{100 * self.pallas_coverage:.0f}% of edges are in "
+                f"kernel-covered degree classes (<= "
+                f"{PALLAS_MAX_WIDTH}); the rest run the XLA paths",
+                stacklevel=2)
 
     def run(
         self,
@@ -962,18 +1026,25 @@ FUSED_SHRINK_EDGES = 1 << 20
 # 538s vs 958s (1.78x); round-2 walls were ~2x faster for identical code,
 # so cross-round ratios reflect host conditions, not code).  The gap is
 # COMPUTE on a CPU mesh — the sparse env's extra per-iteration sort and
-# owner-routing — while the thing the round-3 packing removed (collective
-# LAUNCHES: 7 all_to_all/iter -> 3, pinned by
-# test_sparse_step_lowers_to_three_all_to_all) only matters on real ICI,
-# where per-launch latency charges per collective.  The replicated
-# exchange's per-chip state is O(nv_total): at the v5p-64 north star
-# (padded nv_total ~2^29) that is several multi-GB replicated arrays per
-# chip per iteration — HBM-infeasible, which is exactly why the reference
-# built its sparse protocol (louvain.cpp:2588-3264).  Above this vertex
-# count the driver switches to the sparse O(owned + ghosts) plan; below it
-# the replicated arrays cost at most ~1 GB per chip and the simpler
-# exchange wins.  Re-tune on real multi-chip hardware when available —
-# CUVITE_EXCHANGE_CUTOVER (below) retunes it without a code edit.
+# owner-routing — NOT collective transport: the round-8 launch-latency
+# microbenchmark (tools/exchange_latency.py, log in
+# tools/exchange_latency_r8.log; 8-virtual-device mesh on this host)
+# measures ~0.5-1.2 ms per collective launch with all_gather and
+# all_to_all within ~1.4x of each other, and its transport-only model
+# (3 launches/iter each side, pinned by
+# test_sparse_step_lowers_to_three_all_to_all) already crosses to sparse
+# at nv ~2^12 — four orders of magnitude BELOW this cutover.  So the
+# launch/transport argument cannot justify 2^26 on any measured mesh;
+# what does is HBM: the replicated exchange's per-chip state is
+# O(nv_total), and at the v5p-64 north star (padded nv_total ~2^29) that
+# is several multi-GB replicated arrays per chip per iteration —
+# infeasible, which is exactly why the reference built its sparse
+# protocol (louvain.cpp:2588-3264).  Above this vertex count the driver
+# switches to the sparse O(owned + ghosts) plan; below it the replicated
+# arrays cost at most ~1 GB per chip and the (compute-)simpler exchange
+# wins end-to-end.  Re-run tools/exchange_latency.py on real ICI when a
+# chip window opens — CUVITE_EXCHANGE_CUTOVER (below) retunes the cutover
+# without a code edit.
 AUTO_SPARSE_MIN_VERTICES = 1 << 26
 
 
@@ -1260,7 +1331,12 @@ def louvain_phases(
     """Full multi-phase Louvain (the main.cpp:218-495 loop).
 
     ``engine='auto'`` picks the degree-bucketed step (single-shard and
-    sharded); ``engine='sort'`` forces the edge-slab sort/segment step.
+    sharded); ``engine='sort'`` forces the edge-slab sort/segment step;
+    ``engine='pallas'`` is the bucketed step with every degree class <=
+    PALLAS_MAX_WIDTH routed through the Pallas row-argmax kernel — on a
+    mesh the kernel runs inside the shard_map body under either exchange,
+    and the result carries the kernel-coverage accounting
+    (``pallas_coverage`` / ``pallas_width_hits``).
 
     ``coloring=N`` (reference -c N): distance-1 color the phase-0 graph with
     N/2 hash functions and run the per-color sub-sweep schedule
@@ -1284,9 +1360,9 @@ def louvain_phases(
             raise ValueError(
                 f"nshards={nshards} does not match the DistVite partition "
                 f"({graph.nshards} shards)")
-        if engine not in ("auto", "bucketed"):
+        if engine not in ("auto", "bucketed", "pallas"):
             raise ValueError(
-                "per-host ingest supports only the bucketed engine")
+                "per-host ingest supports only the bucketed/pallas engines")
         if exchange == "auto":
             exchange = "sparse"  # host memory is the constraint here
         if exchange != "sparse":
@@ -1373,6 +1449,10 @@ def louvain_phases(
     phases: list[PhaseStats] = []
     prev_mod = -1.0
     tot_iters = 0
+    # engine='pallas' kernel-coverage accounting, traversed-edge weighted
+    # across phases (coarse phases sweep less mass but more often).
+    cov_num = cov_den = 0
+    width_hits: dict = {}
     t_start = time.perf_counter()
     phase = 0
     g = graph
@@ -1503,8 +1583,11 @@ def louvain_phases(
         # configurations degrade and must say so (cf. pallas/fused).
         multi_mesh = nshards > 1 or (
             mesh is not None and int(np.prod(mesh.devices.shape)) > 1)
-        # Note: engine='pallas' on a mesh is converted to 'bucketed' by
-        # PhaseRunner (with its own warning), so it is class-capable too.
+        # Note: engine='pallas' on a mesh runs the SPMD bucketed step with
+        # the kernel classes inside the shard_map body; under a coloring/
+        # ordering schedule the iteration sweeps the per-class plans, which
+        # are XLA on every engine (matching single-shard pallas), so it is
+        # class-capable too.
         # Both SPMD exchanges support class-restricted plans (sparse:
         # per-class plans stacked over the phase ghost routing, VERDICT r3
         # item 5), including the per-host-ingest partition (local shard
@@ -1607,13 +1690,27 @@ def louvain_phases(
             th, lower=-1.0, et_mode=et_mode, et_delta=et_delta,
             color_classes=color_dev, n_color_classes=n_classes,
         )
-        if verbose and getattr(runner, "pallas_coverage", None) is not None:
-            det = " ".join(
-                f"{'heavy' if w == 0 else w}:{n}{'*' if k else ''}"
-                for w, n, k in runner.pallas_cov_detail)
-            print(f"pallas kernel coverage: "
-                  f"{100 * runner.pallas_coverage:.1f}% of edges "
-                  f"(per-width, * = kernel: {det})")
+        if getattr(runner, "pallas_coverage", None) is not None:
+            for w, n, k in runner.pallas_cov_detail:
+                t = n * iters
+                cov_den += t
+                if k:
+                    cov_num += t
+                    width_hits[w] = width_hits.get(w, 0) + t
+        elif engine == "pallas":
+            # Class-scheduled phases (coloring/ordering — typically phase
+            # 0, the bulk of the run's edge mass) sweep the XLA per-class
+            # plans, never the kernel: their traversed mass counts as
+            # NON-kernelized, or the run-level coverage would report only
+            # the later plain phases and overstate itself.
+            cov_den += g_ne * iters
+            if verbose:
+                det = " ".join(
+                    f"{'heavy' if w == 0 else w}:{n}{'*' if k else ''}"
+                    for w, n, k in runner.pallas_cov_detail)
+                print(f"pallas kernel coverage: "
+                      f"{100 * runner.pallas_coverage:.1f}% of edges "
+                      f"(per-width, * = kernel: {det})")
         # The loop's f32 modularity decided convergence; the REPORTED value
         # is recomputed once per phase with f64-class accuracy
         # (louvain/precise.py) — the analog of the reference's double
@@ -1784,4 +1881,6 @@ def louvain_phases(
         phases=phases,
         total_iterations=tot_iters,
         total_seconds=time.perf_counter() - t_start,
+        pallas_coverage=(cov_num / cov_den) if cov_den else None,
+        pallas_width_hits=width_hits or None,
     )

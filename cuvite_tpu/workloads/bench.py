@@ -37,8 +37,13 @@ BASELINE_EDGES_PER_SEC_PER_CHIP = 1.0e9 / 64.0
 
 REQUIRED_RECORD_KEYS = (
     "metric", "value", "unit", "vs_baseline", "platform", "graph",
-    "modularity", "phases", "compile_guard", "stages",
+    "modularity", "phases", "compile_guard", "stages", "engine",
 )
+
+# Kernel-coverage fields every engine='pallas' record must carry (schema
+# v3, ISSUE 4): without them a pallas TEPS number cannot say how much of
+# the edge mass actually ran through the kernel vs the XLA fallbacks.
+REQUIRED_PALLAS_KEYS = ("pallas_coverage", "pallas_width_hits")
 
 # Per-stage wall-clock fields every record must carry (schema v2, ISSUE 3):
 # the breakdown that makes the device-resident coarsening win measurable
@@ -117,6 +122,22 @@ def validate_record(rec: dict) -> list:
                     problems.append(
                         f"stages[{k!r}] must be a non-negative number, "
                         f"got {v!r}")
+        if rec["engine"] == "pallas":
+            for k in REQUIRED_PALLAS_KEYS:
+                if k not in rec:
+                    problems.append(
+                        f"a pallas record must carry {k!r} (kernel "
+                        "coverage, schema v3)")
+            cov = rec.get("pallas_coverage")
+            if cov is not None and not (
+                    isinstance(cov, (int, float)) and 0.0 <= cov <= 1.0):
+                problems.append(
+                    f"pallas_coverage must be a fraction in [0, 1], "
+                    f"got {cov!r}")
+            hits = rec.get("pallas_width_hits")
+            if "pallas_width_hits" in rec and not isinstance(hits, dict):
+                problems.append("pallas_width_hits must be a dict of "
+                                "width -> traversed kernel edges")
     return problems
 
 
@@ -237,9 +258,18 @@ def run_bench(
             # Per-stage breakdown of the RECORDED run (schema v2): where
             # the phase-transition time goes — coarsen/upload vs iterate.
             "stages": (tr or Tracer()).breakdown(),
+            "engine": engine,
         }
         if scale is not None:
             out["scale"] = scale
+        if res.pallas_coverage is not None:
+            # Kernel-coverage fields (schema v3): traversed-edge-weighted
+            # fraction that ran the Pallas kernel + per-width hit counts,
+            # so a pallas TEPS number carries its own honesty label.
+            out["pallas_coverage"] = round(float(res.pallas_coverage), 4)
+            out["pallas_width_hits"] = {
+                str(w): int(n)
+                for w, n in sorted(res.pallas_width_hits.items())}
         if not compile_guard["checked"]:
             out["compile_included"] = True
         if all_teps:

@@ -308,6 +308,39 @@ def build_plan(plan, comm_pad):
 """,
         "cuvite_tpu/coarsen/fake_r010.py",
     ),
+    (
+        "R011",
+        """
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+def launch(kernel, cT):
+    spec = pl.BlockSpec((8, 512), lambda i: (0, i),
+                        memory_space=pltpu.VMEM)
+    return pl.pallas_call(kernel, grid=(4,), in_specs=[spec],
+                          out_specs=spec, out_shape=None)(cT)
+""",
+        """
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+
+def launch(kernel, cT, tile):
+    D, N = cT.shape
+    # dims derived from the ladder-bound shapes; unit dims are layout
+    # plumbing, not a tile-size decision
+    mat = pl.BlockSpec((D, tile), lambda i: (0, i),
+                       memory_space=pltpu.VMEM)
+    vec = pl.BlockSpec((1, tile), lambda i: (0, i),
+                       memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.pallas_call(kernel, grid=(N // tile,),
+                          in_specs=[smem, mat, vec],
+                          out_specs=vec, out_shape=None)(cT)
+""",
+        "cuvite_tpu/kernels/fake_r011.py",
+    ),
 ]
 
 RULE_IDS = [c[0] for c in RULE_CASES]

@@ -389,7 +389,8 @@ def test_validate_record_rejects_unchecked_nonzero_compiles():
            "phases": 1, "compile_guard": {"checked": True,
                                           "new_compiles": 2},
            "stages": {"coarsen_s": 0.0, "upload_s": 0.0,
-                      "iterate_s": 1.0}}
+                      "iterate_s": 1.0},
+           "engine": "bucketed"}
     assert any("new_compiles" in p for p in validate_record(rec))
     # Schema v2: a record without the stage breakdown (or with a bogus
     # one) is rejected.
@@ -400,6 +401,20 @@ def test_validate_record_rejects_unchecked_nonzero_compiles():
                stages={"coarsen_s": -1.0, "upload_s": 0.0,
                        "iterate_s": 1.0})
     assert any("coarsen_s" in p for p in validate_record(bad))
+    # Schema v3: an engine-less record is rejected, and a pallas record
+    # must carry the kernel-coverage fields (honest TEPS labeling).
+    ok = dict(rec, compile_guard={"checked": True, "new_compiles": 0})
+    noeng = dict(ok)
+    del noeng["engine"]
+    assert any("engine" in p for p in validate_record(noeng))
+    pal = dict(ok, engine="pallas")
+    assert any("pallas_coverage" in p for p in validate_record(pal))
+    assert any("pallas_width_hits" in p for p in validate_record(pal))
+    pal_ok = dict(pal, pallas_coverage=0.93,
+                  pallas_width_hits={"8": 1000, "32": 500})
+    assert validate_record(pal_ok) == []
+    pal_bad = dict(pal_ok, pallas_coverage=1.7)
+    assert any("pallas_coverage" in p for p in validate_record(pal_bad))
 
 
 # ---------------------------------------------------------------------------

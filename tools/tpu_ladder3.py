@@ -10,11 +10,13 @@ valuable result first):
       cuvite_tpu/workloads/bench.py: warm-up, compile-count==0 guard on
       the first timed run, shared JSON schema), stderr preserved per
       scale, JSON checkpointed to disk the moment it exists;
-  A2. compiled Pallas row_argmax parity + min-of-5 timing for the WIDE
-      classes (64/256/2048) vs the XLA sorted-dedup twin — the widths
-      that have only ever run in interpret mode;
+  A2. compiled Pallas row_argmax parity + min-of-5 timing for EVERY
+      staged ladder width in (QUADRATIC_MAX_WIDTH..PALLAS_MAX_WIDTH]
+      vs the XLA sorted-dedup twin — the widths that have only ever run
+      in interpret mode (the SPMD engine routes all of them, ISSUE 4);
   D.  full clustering A/B on chip: bucketed vs pallas vs fused engines,
-      rmat-18 and rmat-20 (--json lines logged);
+      rmat-18 and rmat-20 (--json lines logged); on a multi-chip slice
+      also bucketed vs pallas SPMD over all devices;
   E.  bench at scale 22;
   then tools/heavy_ab.py (heavy-class kernel decision measurement).
 
@@ -107,14 +109,35 @@ def stage_c_retry():
 
 
 def stage_a2(jnp, np):
-    """Wide-width (64/256/2048) compiled Pallas parity + min-of-5 timing
-    vs the XLA sorted twin (folded from tpu_ladder2.py)."""
+    """Compiled Pallas parity + min-of-5 timing vs the XLA sorted twin
+    for EVERY staged ladder width in (64..PALLAS_MAX_WIDTH] — the widths
+    that have only ever run in interpret mode (ISSUE 4: the SPMD engine
+    now routes all of them through the kernel, so the next chip window
+    must prove the whole staged set, not the 64/256/2048 samples).
+    Widths and the cap come from the ladder constants, never literals
+    (graftlint R011's contract)."""
     from cuvite_tpu.kernels.row_argmax import row_argmax_pallas
-    from cuvite_tpu.louvain.bucketed import _row_argmax_sorted
+    from cuvite_tpu.louvain.bucketed import (
+        DEFAULT_BUCKETS,
+        PALLAS_MAX_WIDTH,
+        QUADRATIC_MAX_WIDTH,
+        _row_argmax_sorted,
+    )
 
     SENT = np.iinfo(np.int32).max
     rng = np.random.default_rng(0)
-    for width, n_rows in ((64, 1 << 14), (256, 1 << 13), (2048, 1 << 11)):
+    staged = [w for w in DEFAULT_BUCKETS
+              if QUADRATIC_MAX_WIDTH < w <= PALLAS_MAX_WIDTH]
+
+    def rows_for(width):
+        # ~2^20 elements per case, pow2 rows in [2^9, 2^14] (the kernel
+        # needs >= 128 rows; pow2 keeps its tile math exact).
+        r = (1 << 20) // width
+        r = 1 << (max(r, 1).bit_length() - 1)
+        return min(max(r, 1 << 9), 1 << 14)
+
+    for width in staged:
+        n_rows = rows_for(width)
         nv = 50000
         cmat = rng.integers(0, nv, size=(n_rows, width)).astype(np.int32)
         wmat = (rng.integers(1, 32, size=(n_rows, width)) / 16.0
@@ -171,15 +194,22 @@ def stage_a2(jnp, np):
             f"XLA-sorted {tx*1e3:.2f} ms ({tx/max(tp,1e-9):.2f}x)")
 
 
-def stage_d(platform):
+def stage_d(platform, ndev=1):
     """Full clustering engine A/B on chip (folded from tpu_ladder2.py);
     fused = one host sync per RUN (vs per phase): over a ~1s-rtt tunnel
-    per-phase syncs alone are a visible share of a scale-18 run."""
+    per-phase syncs alone are a visible share of a scale-18 run.  On a
+    multi-chip slice the SPMD rows additionally A/B bucketed vs pallas
+    over all devices (ISSUE 4: the kernel now runs inside shard_map)."""
+    configs = [(engine, 1) for engine in ("bucketed", "pallas", "fused")]
+    if ndev > 1:
+        configs += [(engine, ndev) for engine in ("bucketed", "pallas")]
     for scale in (18, 20):
-        for engine in ("bucketed", "pallas", "fused"):
+        for engine, shards in configs:
             cmd = [sys.executable, "-m", "cuvite_tpu.cli",
                    "--rmat", str(scale), "--engine", engine,
                    "--platform", platform, "--json", "--quiet"]
+            if shards > 1:
+                cmd += ["--shards", str(shards)]
             t0 = time.perf_counter()
             out = subprocess.run(cmd, capture_output=True, text=True,
                                  timeout=2400, cwd=REPO)
@@ -189,8 +219,9 @@ def stage_d(platform):
                 if ln.startswith("{"):
                     line = ln
                     break
-            log(f"D: scale={scale} engine={engine} rc={out.returncode} "
-                f"wall={wall:.0f}s json={line or out.stderr[-200:]}")
+            log(f"D: scale={scale} engine={engine} shards={shards} "
+                f"rc={out.returncode} wall={wall:.0f}s "
+                f"json={line or out.stderr[-200:]}")
 
 
 def stage_e():
@@ -246,7 +277,7 @@ def main():
     except Exception as e:
         log(f"A2: FAILED {type(e).__name__}: {e}")
     try:
-        stage_d(parts[0])
+        stage_d(parts[0], ndev=int(parts[1]))
     except Exception as e:
         log(f"D: FAILED {type(e).__name__}: {e}")
     try:
