@@ -12,7 +12,7 @@ AST-walk cost instead of multi-host reproduction cost.
 
 Layout:
   engine.py   — source loading, rule registry, suppressions, baseline
-  rules.py    — the shipped rule set (R001..R008)
+  rules.py    — the shipped rule set (R001..R012)
   __main__.py — CLI: python -m cuvite_tpu.analysis [paths] [options]
 
 See ANALYSIS.md at the repo root for the rule catalogue, suppression

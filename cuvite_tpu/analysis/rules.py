@@ -1,4 +1,4 @@
-"""graftlint rule set R001..R011 (see ANALYSIS.md for the catalogue).
+"""graftlint rule set R001..R012 (see ANALYSIS.md for the catalogue).
 
 Each rule targets a hazard class this codebase has actually hit (or is
 one refactor away from hitting): host syncs inside jitted code, jit
@@ -7,8 +7,9 @@ collective-order divergence across hosts, mutation of caller-owned
 buffers, non-exact reductions feeding modularity, unbounded child
 processes in tools, host-global side effects in test fixtures, network
 access outside the workloads fetch path (or without checksum
-verification), device->host pulls in phase-transition code, and Pallas
-block shapes not derived from the static width-ladder constants.
+verification), device->host pulls in phase-transition code, Pallas
+block shapes not derived from the static width-ladder constants, and
+bench timing windows that close without forcing device completion.
 
 Rules are heuristic by design: they trade completeness for a near-zero
 false-positive rate on idiomatic code, and every remaining intentional
@@ -20,7 +21,12 @@ from __future__ import annotations
 
 import ast
 
-from cuvite_tpu.analysis.engine import Rule, dotted, register
+from cuvite_tpu.analysis.engine import (
+    _JIT_NAMES,
+    Rule,
+    dotted,
+    register,
+)
 
 # Directories whose modules run (or build arrays for) the device path.
 DEVICE_PATH_PREFIXES = (
@@ -792,3 +798,123 @@ class DeviceArrayHostPull(Rule):
                         "scalars instead, or justify with an inline "
                         "disable (the final label gather is the "
                         "allowlisted case)")
+
+
+# ---------------------------------------------------------------------------
+# R012: async-dispatch mistiming in bench/tool timing windows (ISSUE 6).
+# Every recorded perf number comes from a time.perf_counter() pair in
+# tools/ or the bench harness; jax dispatch is ASYNC, so a window that
+# directly dispatches device work and closes without forcing completion
+# records launch latency, not execution time (the round-8 exchange
+# microbenchmark was nearly rewritten with exactly this bug).
+
+_TIMING_SCOPE_PREFIX = "tools/"
+_TIMING_SCOPE_FILES = ("cuvite_tpu/workloads/bench.py",)
+_PERF_COUNTER_CALLS = {"time.perf_counter", "perf_counter"}
+# Evidence the window forces device completion (or reads the value back,
+# which blocks just as hard — the tools prefer real readbacks because
+# block_until_ready is unreliable over the axon tunnel).
+_TIMING_SYNC_CALLS = {
+    "float", "int", "bool",
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "jax.device_get", "jax.block_until_ready", "block_until_ready",
+}
+_TIMING_SYNC_ATTRS = {"block_until_ready", "item", "tolist"}
+# Direct device-dispatch evidence.  Conservative by design: jnp ops,
+# explicit uploads, and in-file jit-bound names.  Calls into opaque
+# callables (louvain_phases, a passed-in fn) are NOT flagged — the
+# callee may sync internally, and flagging them would bury the signal.
+_DISPATCH_PREFIXES = ("jnp.", "jax.numpy.")
+_DISPATCH_CALLS = {"jax.device_put"}
+
+
+@register
+class UnsyncedTimingWindow(Rule):
+    id = "R012"
+    severity = "medium"
+    title = "perf_counter timing window closes without forcing device " \
+            "completion"
+
+    def _jit_names(self, sf) -> set:
+        names = {info.name for info in sf.functions if info.is_jit}
+        names.update(sf.jit_wrapped)
+        for node in sf.walk():
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and dotted(node.value.func) in _JIT_NAMES:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        return names
+
+    def check(self, sf):
+        if not (sf.rel.startswith(_TIMING_SCOPE_PREFIX)
+                or sf.rel in _TIMING_SCOPE_FILES):
+            return
+        jit_names = self._jit_names(sf)
+        opens: dict = {}    # (scope id, var name) -> [linenos]
+        closes: list = []   # (scope, var name, BinOp node)
+        calls: dict = {}    # scope id -> [Call nodes]
+        for node in sf.walk():
+            scope = sf.enclosing_function(node)
+            key = id(scope)
+            if isinstance(node, ast.Call):
+                calls.setdefault(key, []).append(node)
+                continue
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and dotted(node.value.func) in _PERF_COUNTER_CALLS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        opens.setdefault((key, t.id), []).append(
+                            node.lineno)
+            elif isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, ast.Sub) \
+                    and isinstance(node.left, ast.Call) \
+                    and dotted(node.left.func) in _PERF_COUNTER_CALLS \
+                    and isinstance(node.right, ast.Name):
+                closes.append((key, node.right.id, node))
+        for key, var, close in closes:
+            begins = [ln for ln in opens.get((key, var), ())
+                      if ln < close.lineno]
+            if not begins:
+                continue  # window opened elsewhere (param, outer scope)
+            begin = max(begins)
+            inside = [c for c in calls.get(key, ())
+                      if begin < c.lineno < close.lineno]
+            dispatch = None
+            last_dispatch_ln = None
+            sync_lns = []
+            for c in inside:
+                fname = dotted(c.func) or ""
+                if fname in _TIMING_SYNC_CALLS or (
+                        isinstance(c.func, ast.Attribute)
+                        and c.func.attr in _TIMING_SYNC_ATTRS):
+                    # end_lineno: a wrapped readback whose argument
+                    # spans lines (block_until_ready(\n jnp.dot(...)))
+                    # still encloses the dispatch it forces.
+                    sync_lns.append(getattr(c, "end_lineno", None)
+                                    or c.lineno)
+                    continue
+                if fname.startswith(_DISPATCH_PREFIXES) \
+                        or fname in _DISPATCH_CALLS \
+                        or (isinstance(c.func, ast.Name)
+                            and c.func.id in jit_names):
+                    dispatch = dispatch or fname or c.func.id
+                    if last_dispatch_ln is None \
+                            or c.lineno > last_dispatch_ln:
+                        last_dispatch_ln = c.lineno
+            # Sync evidence must not PRECEDE the last dispatch: a
+            # readback before the dispatch forces nothing, and bare
+            # int()/float() on host values are everywhere in bench code.
+            # >= keeps same-line wrapping (float(jnp.dot(...))) clean.
+            synced = dispatch is not None and any(
+                ln >= last_dispatch_ln for ln in sync_lns)
+            if dispatch and not synced:
+                yield self.finding(
+                    sf, close,
+                    f"timing window ({var} opened line {begin}) times "
+                    f"the device dispatch '{dispatch}' but closes "
+                    "without forcing completion (block_until_ready / a "
+                    "readback): jax dispatch is async, so this records "
+                    "launch latency, not execution time")

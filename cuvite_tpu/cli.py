@@ -140,6 +140,19 @@ def build_parser() -> argparse.ArgumentParser:
                      help="write per-shard diagnostic files PREFIX.<shard> "
                           "(the reference's dat.out.<rank> streams, "
                           "main.cpp:101-110)")
+    out.add_argument("--trace-out", metavar="FILE.jsonl",
+                     help="write the flight recorder's structured "
+                          "span/event trace as JSONL (see "
+                          "OBSERVABILITY.md for the schema)")
+    out.add_argument("--metrics-out", metavar="FILE.json",
+                     help="write a machine-readable metrics summary: "
+                          "per-phase convergence curves, stage times, "
+                          "XLA compile events, HBM peaks")
+    out.add_argument("--profile-dir", metavar="DIR",
+                     help="capture a jax.profiler trace + device-memory "
+                          "profile of the run under DIR (TensorBoard "
+                          "format; allocator truth complementing the "
+                          "flight recorder's logical HBM ledger)")
     out.add_argument("--quiet", action="store_true")
     return p
 
@@ -211,6 +224,9 @@ def main(argv=None) -> int:
             args.dist_stats = False
             args.diag_prefix = None
             args.write_graph = None
+            args.trace_out = None
+            args.metrics_out = None
+            args.profile_dir = None
 
     from cuvite_tpu.core.graph import Graph  # noqa: F401 (re-export context)
     from cuvite_tpu.evaluate.compare import (
@@ -254,29 +270,50 @@ def main(argv=None) -> int:
 
     from cuvite_tpu.utils.trace import Tracer
 
-    tracer = Tracer(enabled=args.trace)
-    res = louvain_phases(
-        graph,
-        nshards=args.shards,
-        threshold=args.threshold,
-        threshold_cycling=args.threshold_cycling,
-        one_phase=args.one_phase,
-        balanced=args.balanced,
-        et_mode=args.early_term or 0,
-        et_delta=args.et_delta,
-        engine=args.engine,
-        exchange=args.exchange,
-        coloring=args.coloring or 0,
-        vertex_ordering=args.vertex_ordering or 0,
-        verbose=not args.quiet,
-        tracer=tracer,
-        checkpoint_dir=args.checkpoint_dir,
-        resume=args.resume,
-        dist_stats=args.dist_stats,
-        diag_prefix=args.diag_prefix,
-    )
+    # Flight recorder (ISSUE 6): any of --trace-out / --metrics-out /
+    # --profile-dir attaches one; the drivers thread their telemetry
+    # through the tracer unconditionally, so a run without these flags
+    # pays nothing.
+    import contextlib
+
+    recorder = None
+    rec_ctx = contextlib.nullcontext()
+    if args.trace_out or args.metrics_out or args.profile_dir:
+        from cuvite_tpu.obs import NO_TRACE, FlightRecorder, JsonlTraceSink
+
+        # Without --trace-out the recorder serves --metrics-out /
+        # --profile-dir only (compile events + HBM ledger): NO_TRACE
+        # skips the emitter so no unread span records accumulate.
+        sink = JsonlTraceSink(args.trace_out) if args.trace_out else NO_TRACE
+        recorder = FlightRecorder(sink, profile_dir=args.profile_dir)
+        rec_ctx = recorder
+
+    tracer = Tracer(enabled=args.trace, recorder=recorder)
+    with rec_ctx:
+        res = louvain_phases(
+            graph,
+            nshards=args.shards,
+            threshold=args.threshold,
+            threshold_cycling=args.threshold_cycling,
+            one_phase=args.one_phase,
+            balanced=args.balanced,
+            et_mode=args.early_term or 0,
+            et_delta=args.et_delta,
+            engine=args.engine,
+            exchange=args.exchange,
+            coloring=args.coloring or 0,
+            vertex_ordering=args.vertex_ordering or 0,
+            verbose=not args.quiet,
+            tracer=tracer,
+            checkpoint_dir=args.checkpoint_dir,
+            resume=args.resume,
+            dist_stats=args.dist_stats,
+            diag_prefix=args.diag_prefix,
+        )
     if args.trace:
         print(tracer.report())
+    if args.trace_out and not args.quiet:
+        print(f"Wrote trace to {args.trace_out}")
 
     if args.dist_ingest:
         # No process holds the full graph; the driver's distributed f64
@@ -313,18 +350,38 @@ def main(argv=None) -> int:
         cmp_res = compare_communities(truth, res.communities)
         print(cmp_res.report())
 
+    summary = {
+        "graph": name,
+        "nv": graph.num_vertices,
+        "ne": graph.num_edges,
+        "modularity": q,
+        "communities": res.num_communities,
+        "iterations": res.total_iterations,
+        "phases": len(res.phases),
+        "seconds": res.total_seconds,
+        "teps": teps,
+    }
     if args.json:
-        print(json.dumps({
-            "graph": name,
-            "nv": graph.num_vertices,
-            "ne": graph.num_edges,
-            "modularity": q,
-            "communities": res.num_communities,
-            "iterations": res.total_iterations,
-            "phases": len(res.phases),
-            "seconds": res.total_seconds,
-            "teps": teps,
-        }))
+        print(json.dumps(summary))
+
+    if args.metrics_out:
+        from cuvite_tpu.utils.trace import rss_high_water_mb
+
+        metrics = dict(summary)
+        metrics["stages"] = tracer.breakdown()
+        metrics["rss_mb"] = round(rss_high_water_mb(), 1)
+        if res.convergence:
+            metrics["convergence"] = [pc.to_dict()
+                                      for pc in res.convergence]
+        if recorder is not None:
+            metrics["compile_events"] = recorder.compile_events
+            metrics["hbm_peak_by_buffer"] = recorder.ledger.peak_by_buffer
+            metrics["hbm_snapshots"] = recorder.ledger.snapshots
+        with open(args.metrics_out, "w", encoding="utf-8") as f:
+            json.dump(metrics, f, indent=1)
+            f.write("\n")
+        if not args.quiet:
+            print(f"Wrote metrics to {args.metrics_out}")
     return 0
 
 

@@ -152,6 +152,19 @@ class ExchangePlan:
             max_ghosts=max_g,
         )
 
+    def stats(self) -> dict:
+        """Plan-shape digest for the flight recorder's ``exchange`` event
+        (obs/events.py): the numbers that decide per-iteration comm volume
+        — O(S*B) sent per shard, G-table ghost reads — and the padding
+        waste (max_ghosts vs ghost_pad)."""
+        return {
+            "nshards": self.nshards,
+            "block": self.block,
+            "ghost_pad": self.ghost_pad,
+            "max_ghosts": self.max_ghosts,
+            "ghosts_per_shard": [len(g) for g in self.ghost_ids],
+        }
+
     def remap_dst(self, s: int, src: np.ndarray, dst: np.ndarray) -> np.ndarray:
         """Rewrite shard s's global-padded dst ids into the shard-extended
         local space [0, nv_pad + ghost_pad): owned -> local index, ghost ->

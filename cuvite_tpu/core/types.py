@@ -18,6 +18,16 @@ import numpy as np
 TERMINATION_PHASE_COUNT = 200
 MAX_TOTAL_ITERATIONS = 10_000
 
+# Per-phase convergence telemetry: the device phase loops accumulate one
+# (dQ, moved, overflow) row per iteration into fixed-size buffers of this
+# many rows, synced to the host ONCE at phase end together with the
+# existing convergence scalars (obs/convergence.py).  Phases running more
+# iterations than this drop the tail rows (the PhaseConvergence carries a
+# ``truncated`` flag); real phases converge in well under 128 iterations
+# (the reference caps a whole RUN at MAX_TOTAL_ITERATIONS).  Static, so
+# every phase shares one compiled loop regardless of its iteration count.
+CONV_ROWS_CAP = 128
+
 # Early-termination constants (cf. /root/reference/louvain.hpp:74-80).
 ET_CUTOFF = 0.90  # fraction of frozen vertices that stops the iteration loop
 P_CUTOFF = 0.02   # probability floor below which a vertex freezes (ET modes 2/4)

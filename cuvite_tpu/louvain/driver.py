@@ -39,6 +39,7 @@ from cuvite_tpu.comm.multihost import gather_global
 from cuvite_tpu.core.distgraph import DistGraph
 from cuvite_tpu.core.graph import Graph
 from cuvite_tpu.core.types import (
+    CONV_ROWS_CAP,
     ET_CUTOFF,
     MAX_TOTAL_ITERATIONS,
     P_CUTOFF,
@@ -56,6 +57,12 @@ from cuvite_tpu.louvain.bucketed import (
 )
 from cuvite_tpu.louvain.precise import phase_modularity
 from cuvite_tpu.louvain.step import make_sharded_step, make_single_step
+from cuvite_tpu.obs.convergence import (
+    MOVED_UNTRACKED,
+    ConvRow,
+    PhaseConvergence,
+    decode_phase_conv,
+)
 from cuvite_tpu.utils.upload import aligned_copy, to_device
 
 
@@ -95,6 +102,12 @@ class LouvainResult:
     # heavy class, kernelized widths flagged by workloads/bench.py).
     pallas_coverage: float | None = None
     pallas_width_hits: dict | None = None
+    # Per-phase convergence telemetry (ISSUE 6): list of
+    # obs.PhaseConvergence — one entry per phase ATTEMPT in run order
+    # (the per-phase drivers record non-gaining final attempts too, with
+    # ``gained=False``; the fused engine records gaining phases only).
+    # None when the run predates telemetry (e.g. deserialized results).
+    convergence: list | None = None
 
     @property
     def num_communities(self) -> int:
@@ -242,6 +255,27 @@ def _bucketed_mod_jit(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
 # device, and syncs once per phase.  Semantics are identical to
 # PhaseRunner.run's Python loop (the returned assignment is `past`, the last
 # one whose gain passed the threshold).
+#
+# Convergence telemetry (ISSUE 6): each iteration also writes one
+# (Q, moved, overflow) row into fixed CONV_ROWS_CAP-sized buffers carried
+# through the while_loop; rows beyond the cap drop on device (mode="drop"
+# scatter — the PhaseConvergence decode flags truncation from the exact
+# scalar count).  The buffers return with the scalars and ride the SAME
+# per-phase host sync — zero added syncs, and the step's decisions never
+# read them, so labels are bit-identical with or without a consumer.
+
+def _conv_init(wdt):
+    return (jnp.zeros((CONV_ROWS_CAP,), dtype=wdt),
+            jnp.zeros((CONV_ROWS_CAP,), dtype=jnp.int32),
+            jnp.zeros((CONV_ROWS_CAP,), dtype=bool))
+
+
+def _conv_push(conv, iters, mod, moved, step_ovf):
+    cq, cmoved, covf = conv
+    return (cq.at[iters].set(mod, mode="drop"),
+            cmoved.at[iters].set(moved.astype(jnp.int32), mode="drop"),
+            covf.at[iters].set(step_ovf, mode="drop"))
+
 
 @functools.partial(jax.jit, static_argnames=("call", "max_iters"))
 def _run_phase_loop(extra, comm0, threshold, lower, *, call, max_iters):
@@ -251,24 +285,32 @@ def _run_phase_loop(extra, comm0, threshold, lower, *, call, max_iters):
         return ~c[4]
 
     def body(c):
-        past, comm, prev_mod, iters, _, ovf = c
+        past, comm, prev_mod, iters, _, ovf, conv = c
         # Uniform step contract: (target, modularity, n_moved, overflow).
         # The overflow flag (sparse-exchange budget) accumulates so the host
         # detects an invalid phase with ONE sync at the end.
-        target, mod, _, step_ovf = call(comm, extra)
+        target, mod, moved, step_ovf = call(comm, extra)
         mod = mod.astype(wdt)
-        iters1 = iters + 1
         no_gain = (mod - prev_mod) < threshold
+        # The no-gain sweep's proposals are rolled back below (new_comm
+        # keeps comm): its row records 0 applied moves, not the
+        # discarded proposal count — moved_total() must equal real
+        # label churn.
+        conv = _conv_push(conv, iters, mod,
+                          jnp.where(no_gain, 0, moved), step_ovf)
+        iters1 = iters + 1
         stop = no_gain | (iters1 >= max_iters)
         new_prev = jnp.where(no_gain, prev_mod, jnp.maximum(mod, lower))
         new_past = jnp.where(no_gain, past, comm)
         new_comm = jnp.where(no_gain, comm, target)
-        return (new_past, new_comm, new_prev, iters1, stop, ovf | step_ovf)
+        return (new_past, new_comm, new_prev, iters1, stop, ovf | step_ovf,
+                conv)
 
     init = (comm0, comm0, lower, jnp.int32(0), jnp.bool_(False),
-            jnp.zeros((), dtype=bool))
-    past, _, prev_mod, iters, _, ovf = jax.lax.while_loop(cond, body, init)
-    return past, prev_mod, iters, ovf
+            jnp.zeros((), dtype=bool), _conv_init(wdt))
+    past, _, prev_mod, iters, _, ovf, conv = jax.lax.while_loop(
+        cond, body, init)
+    return past, prev_mod, iters, ovf, conv
 
 
 @functools.partial(
@@ -295,10 +337,16 @@ def _run_phase_loop_et(extra, comm0, threshold, lower, active0, et_delta,
         return ~c[4]
 
     def body(c):
-        past, comm, prev_mod, iters, _, ovf, active, p_act = c
+        past, comm, prev_mod, iters, _, ovf, active, p_act, conv = c
         target, mod, _, step_ovf = call(comm, extra)
         target = jnp.where(active, target, comm)
         mod = mod.astype(wdt)
+        # Recount APPLIED moves after the freeze mask: the step's n_moved
+        # counts proposals, including frozen vertices whose moves the
+        # mask just discarded — the telemetry rows must reflect real
+        # label churn (non-movers keep target == comm in every step, so
+        # the recount equals sum(active & move)).
+        moved = jnp.sum((target != comm).astype(jnp.int32))
         iters1 = iters + 1
         if et_stop:
             frozen = nv_real - jnp.sum(active.astype(jnp.int32))
@@ -308,6 +356,10 @@ def _run_phase_loop_et(extra, comm0, threshold, lower, active0, et_delta,
         no_gain = (mod - prev_mod) < threshold
         stop = no_gain | frozen_stop | (iters1 >= max_iters)
         cont = ~(no_gain | frozen_stop)
+        # Like the default loop: a stopping sweep's proposals are rolled
+        # back (new_comm keeps comm), so its row records 0 applied moves.
+        conv = _conv_push(conv, iters, mod,
+                          jnp.where(cont, moved, 0), step_ovf)
         upd = cont & (iters1 > 2)
         if prob:
             decayed = active & (comm == past)
@@ -323,14 +375,29 @@ def _run_phase_loop_et(extra, comm0, threshold, lower, active0, et_delta,
         new_past = jnp.where(cont, comm, past)
         new_comm = jnp.where(cont, target, comm)
         return (new_past, new_comm, new_prev, iters1, stop,
-                ovf | step_ovf, active_new, p_act)
+                ovf | step_ovf, active_new, p_act, conv)
 
     p0 = jnp.ones_like(comm0, dtype=wdt)
     init = (comm0, comm0, lower, jnp.int32(0), jnp.bool_(False),
-            jnp.zeros((), dtype=bool), active0, p0)
-    past, _, prev_mod, iters, _, ovf, _, _ = jax.lax.while_loop(
+            jnp.zeros((), dtype=bool), active0, p0, _conv_init(wdt))
+    past, _, prev_mod, iters, _, ovf, _, _, conv = jax.lax.while_loop(
         cond, body, init)
-    return past, prev_mod, iters, ovf
+    return past, prev_mod, iters, ovf, conv
+
+
+def _phase_sync(labels, *rest):
+    """THE per-phase device->host sync chokepoint: labels + the scalar/
+    telemetry pytree come back in ONE transfer (a single jax.device_get
+    of the whole tuple), so the host blocks exactly once per phase — the
+    property tests/test_obs.py's sync spy pins.  Multi-host runs need the
+    collective allgather for the sharded labels; the replicated scalars
+    still batch into one fetch."""
+    from cuvite_tpu.comm.multihost import is_distributed
+
+    if not is_distributed():
+        out = jax.device_get((labels, rest))  # graftlint: disable=R010 — THE per-phase scalar+label sync chokepoint
+        return np.asarray(out[0]), out[1]
+    return gather_global(labels), jax.device_get(rest)  # graftlint: disable=R010 — replicated scalars, O(CONV_ROWS_CAP)
 
 
 @functools.lru_cache(maxsize=None)
@@ -402,6 +469,7 @@ class PhaseRunner:
         self.mesh = mesh
         self.engine = engine
         self.labels_dev = None      # device labels of the last run() phase
+        self.convergence = None     # PhaseConvergence of the last run()
         self.budget = None
 
         def _up(x, dtype=None):
@@ -413,6 +481,7 @@ class PhaseRunner:
             with tracer.stage("upload"):
                 return to_device(x, dtype)
         self.ghost_counts = None    # per-shard ghost counts (sparse plan)
+        self.xplan_stats = None     # ExchangePlan.stats() (sparse plan)
         self._class_plans = None    # per-color-class bucket plans
         self._mod_args = None       # full-plan args for the mod pass
         self._mod_fn = None         # sharded mod fn (SPMD class schedule)
@@ -479,7 +548,8 @@ class PhaseRunner:
                 from cuvite_tpu.comm.exchange import ExchangePlan
 
                 xplan = ExchangePlan.build(dg)
-                self.ghost_counts = [len(g) for g in xplan.ghost_ids]
+                self.xplan_stats = xplan.stats()
+                self.ghost_counts = self.xplan_stats["ghosts_per_shard"]
                 if budget is None:
                     budget = max(128, dg.nv_pad // 4)
                 budget = min(int(budget), dg.nv_pad)
@@ -796,6 +866,27 @@ class PhaseRunner:
             # Bucket matrices replaced the slab; at benchmark scale the
             # host slab is tens of GB of dead weight from here on.
             dg.release_slabs()
+        # HBM ledger (ISSUE 6): account every device buffer this runner
+        # placed, by logical category — slab (edge triples), tables
+        # (per-vertex state), plans (bucket matrices + assembly perm,
+        # incl. per-class plans), exchange (sparse ghost routing).
+        # Callables/None in the pytrees contribute nothing (no .nbytes).
+        tracer.ledger_phase_begin()
+        if self.src is not None:
+            tracer.track("slab", self.src, self.dst, self.w)
+        tracer.track("tables", self.vdeg, self.comm0, self.real_mask_dev,
+                     self.constant)
+        if self._bucket_extra is not None:
+            # Layout: (buckets, heavy, self_loop, perm[, send_idx,
+            # ghost_sel]) — the tail beyond the perm is the sparse
+            # exchange routing.
+            tracer.track("plans", *jax.tree_util.tree_leaves(
+                self._bucket_extra[:4]))
+            tracer.track("exchange", *jax.tree_util.tree_leaves(
+                self._bucket_extra[4:]))
+        if self._class_plans is not None:
+            tracer.track("plans", *jax.tree_util.tree_leaves(
+                self._class_plans))
 
     def _record_pallas_coverage(self, cov) -> None:
         """Per-width kernel-coverage accounting (VERDICT r3 weak #4): a
@@ -867,21 +958,24 @@ class PhaseRunner:
             # Host scalars stay numpy: jit replicates them on any mesh,
             # including multi-host ones where a committed local jnp array
             # could not join a global computation.
-            past_d, prev_mod_d, iters_d, ovf_d = _run_phase_loop(
+            past_d, prev_mod_d, iters_d, ovf_d, conv_d = _run_phase_loop(
                 self._extra, self.comm0,
                 np.asarray(threshold, dtype=wdt),
                 np.asarray(lower, dtype=wdt),
                 call=self._call, max_iters=MAX_TOTAL_ITERATIONS,
             )
             self.labels_dev = past_d
-            return (gather_global(past_d), float(prev_mod_d),
-                    int(iters_d), bool(ovf_d))
+            labels, (prev_mod, iters, ovf, cq, cmoved, covf) = _phase_sync(
+                past_d, prev_mod_d, iters_d, ovf_d, *conv_d)
+            self.convergence = decode_phase_conv(
+                -1, int(iters), cq, cmoved, covf)
+            return labels, float(prev_mod), int(iters), bool(ovf)
         if color_classes is None and self._class_plans is None:
             # ET modes 1-4 without coloring: freeze state lives in the
             # device loop's carry — one host sync per phase, like the
             # default path.
             wdt = np.dtype(self.constant.dtype)
-            past_d, prev_mod_d, iters_d, ovf_d = _run_phase_loop_et(
+            past_d, prev_mod_d, iters_d, ovf_d, conv_d = _run_phase_loop_et(
                 self._extra, self.comm0,
                 np.asarray(threshold, dtype=wdt),
                 np.asarray(lower, dtype=wdt),
@@ -891,13 +985,21 @@ class PhaseRunner:
                 et_mode=et_mode, nv_real=int(self.real_mask.sum()),
             )
             self.labels_dev = past_d
-            return (gather_global(past_d), float(prev_mod_d),
-                    int(iters_d), bool(ovf_d))
+            labels, (prev_mod, iters, ovf, cq, cmoved, covf) = _phase_sync(
+                past_d, prev_mod_d, iters_d, ovf_d, *conv_d)
+            self.convergence = decode_phase_conv(
+                -1, int(iters), cq, cmoved, covf)
+            return labels, float(prev_mod), int(iters), bool(ovf)
         comm = self.comm0
         past = comm
         prev_mod = lower
         iters = 0
         overflow = False
+        # Host-loop schedules already pay one sync per iteration for the
+        # convergence check — the telemetry rows reuse that value; the
+        # moved count is NOT fetched (it would add a sync per iteration),
+        # so rows carry MOVED_UNTRACKED.
+        conv_rows: list = []
         et_stop = et_mode in (3, 4)
         if et_mode:
             active = self.real_mask_dev
@@ -988,6 +1090,15 @@ class PhaseRunner:
                     and self._class_plans is None:
                 target = jnp.where(active, target, comm)
             curr_mod = float(mod)
+            # Same bound as the device buffers: rows hold at most
+            # CONV_ROWS_CAP iterations (MAX_TOTAL_ITERATIONS is 10k —
+            # unbounded rows would bloat every trace event/metrics
+            # export); the exact count lives in `iterations` and
+            # truncation is flagged below, matching decode_phase_conv.
+            if len(conv_rows) < CONV_ROWS_CAP:
+                conv_rows.append(ConvRow(
+                    iteration=iters - 1, q=curr_mod,
+                    moved=MOVED_UNTRACKED))
             if et_stop:
                 frozen = nv_real - int(jnp.sum(active))
                 if frozen >= ET_CUTOFF * nv_real:
@@ -1008,6 +1119,9 @@ class PhaseRunner:
             if iters >= MAX_TOTAL_ITERATIONS:
                 break
         self.labels_dev = past
+        self.convergence = PhaseConvergence(
+            phase=-1, rows=conv_rows, iterations=iters,
+            truncated=iters > CONV_ROWS_CAP)
         return gather_global(past), prev_mod, iters, overflow
 
 
@@ -1028,7 +1142,7 @@ FUSED_SHRINK_EDGES = 1 << 20
 # COMPUTE on a CPU mesh — the sparse env's extra per-iteration sort and
 # owner-routing — NOT collective transport: the round-8 launch-latency
 # microbenchmark (tools/exchange_latency.py, log in
-# tools/exchange_latency_r8.log; 8-virtual-device mesh on this host)
+# tools/logs/exchange_latency_r8.log; 8-virtual-device mesh on this host)
 # measures ~0.5-1.2 ms per collective launch with all_gather and
 # all_to_all within ~1.4x of each other, and its transport-only model
 # (3 launches/iter each side, pinned by
@@ -1118,6 +1232,7 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
     g = graph
     comm_all = np.arange(graph.num_vertices, dtype=np.int64)
     phases: list[PhaseStats] = []
+    convergence: list = []  # PhaseConvergence per GAINING fused phase
     tot_iters = 0
     prev_mod = -1.0
     dg = None
@@ -1154,13 +1269,24 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
                 phase0=np.int32(len(phases)),
                 iter_budget=np.int32(MAX_TOTAL_ITERATIONS - tot_iters),
             )
-            # Labels stay in HBM; the per-phase host sync fetches only the
+            # Labels stay in HBM; the per-call host sync fetches only the
             # scalars + O(max_phases) stat vectors.
             labels_d = out[0]
             (loop_mod, n_phases, iters, mod_hist, iter_hist,
-             nc_hist) = jax.device_get(out[1:])  # graftlint: disable=R010 — scalar/stat-only sync, O(max_phases)
+             nc_hist) = jax.device_get(out[1:7])  # graftlint: disable=R010 — scalar/stat-only sync, O(max_phases)
+            n_phases = int(n_phases)
+        # The stat fetch above already blocked on program completion, so
+        # the timing window closes HERE: call_s (→ PhaseStats.seconds,
+        # the bench/regression-gate number) must not absorb the
+        # telemetry readback below.
         call_s = time.perf_counter() - t_call
-        n_phases = int(n_phases)
+        # Convergence rows: a second fetch SLICED to the phases this
+        # call actually ran — O(n_phases * CONV_ROWS_CAP), still
+        # per-call not per-iteration; the full [max_phases, CAP]
+        # buffers would put a 25k-element transfer on an otherwise
+        # stat-sized sync (the transfer-guard tests cap fetch sizes).
+        conv_slices = (out[7][:n_phases], out[8][:n_phases])
+        cq_hist, cmoved_hist = jax.device_get(conv_slices)  # graftlint: disable=R010 — conv telemetry, O(n_phases * CONV_ROWS_CAP)
         tot_iters += int(iters)
         tracer.count("traversed_edges", real_ne * int(iters))
         nv_p = real_nv
@@ -1171,9 +1297,15 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
                 num_edges=real_ne,
                 seconds=call_s / n_phases,
             ))
+            st = phases[-1]
+            pc = decode_phase_conv(
+                st.phase, st.iterations, cq_hist[p], cmoved_hist[p],
+                gained=True)
+            convergence.append(pc)
+            if tracer.emitter is not None:  # to_dict is ~CAP row dicts
+                tracer.event("convergence", **pc.to_dict())
             nv_p = int(nc_hist[p])
             if verbose:
-                st = phases[-1]
                 print(f"Level {st.phase}, Modularity: {st.modularity:.6f}, "
                       f"Iterations: {st.iterations}, nv: {st.num_vertices}")
         if n_phases:
@@ -1195,6 +1327,7 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
                 dense, nc = renumber_communities(comm_lvl)
                 comm_all = dense[comm_all]
             prev_mod = float(loop_mod)
+        tracer.ledger_snapshot(phases[-1].phase if phases else None)
         return n_phases
 
     while True:
@@ -1212,6 +1345,9 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
                 dst_d = jnp.asarray(np.asarray(sh.dst).astype(np.int32))
                 w_d = jnp.asarray(np.asarray(sh.w).astype(wdt))
                 real_mask_d = jnp.asarray(dg.vertex_mask())
+            tracer.ledger_phase_begin()
+            tracer.track("slab", src_d, dst_d, w_d)
+            tracer.track("tables", real_mask_d)
         remaining = max_p - len(phases)
         # Big slab: run ONE phase, compact, come back.  Small (or final)
         # slab: let the device program run everything remaining (incl.
@@ -1257,6 +1393,9 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
                     nv_pad=nv_pad, ne_pad=ne_pad)
                 real_mask_d = jnp.arange(nv_pad, dtype=jnp.int32) \
                     < jnp.int32(real_nv)
+                tracer.ledger_phase_begin()
+                tracer.track("slab", src_d, dst_d, w_d)
+                tracer.track("tables", real_mask_d, labels_d)
             else:
                 g = coarsen_graph(g, dense, nc)
                 real_nv, real_ne = g.num_vertices, g.num_edges
@@ -1302,6 +1441,7 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
         phases=phases,
         total_iterations=tot_iters,
         total_seconds=total_s,
+        convergence=convergence,
     )
 
 
@@ -1447,6 +1587,7 @@ def louvain_phases(
         )
 
     phases: list[PhaseStats] = []
+    convergence: list = []  # PhaseConvergence per phase ATTEMPT (ISSUE 6)
     prev_mod = -1.0
     tot_iters = 0
     # engine='pallas' kernel-coverage accounting, traversed-edge weighted
@@ -1543,6 +1684,12 @@ def louvain_phases(
         g_is_dv = getattr(g, "local_only", False)
         g_nv = g.num_vertices
         g_ne = g.num_edges
+        # Flight-recorder phase envelope: stages/events below nest under
+        # it; ended at every exit of this loop body (begin_span because
+        # the body has breaks a `with` block cannot straddle cleanly).
+        tracer.set_phase(phase)
+        _phase_sid = tracer.begin_span("phase", index=phase, nv=g_nv,
+                                       ne=g_ne, threshold=float(th))
         # Shape floors: every coarsened phase small enough to fit them reuses
         # one compiled step instead of recompiling per phase.
         # Single-shard bucketed engines never upload the edge slab: skip
@@ -1690,6 +1837,12 @@ def louvain_phases(
             th, lower=-1.0, et_mode=et_mode, et_delta=et_delta,
             color_classes=color_dev, n_color_classes=n_classes,
         )
+        # Capture BEFORE the slabless branch drops the runner; gained is
+        # stamped (and the event emitted) once it is known below.
+        phase_conv = getattr(runner, "convergence", None)
+        tracer.event("exchange", mode=phase_exchange,
+                     nshards=dg.nshards, budget=runner.budget,
+                     plan=runner.xplan_stats)
         if getattr(runner, "pallas_coverage", None) is not None:
             for w, n, k in runner.pallas_cov_detail:
                 t = n * iters
@@ -1727,6 +1880,7 @@ def louvain_phases(
         t2 = time.perf_counter()
         tot_iters += iters
         tracer.count("traversed_edges", g_ne * iters)
+        tracer.ledger_snapshot(phase)
         if dist_stats:
             from cuvite_tpu.utils.trace import dist_stats_report
 
@@ -1747,6 +1901,12 @@ def louvain_phases(
         comm_old = comm_pad[dg.old_to_pad]  # label (padded id) per real vertex
 
         gained = (curr_mod - prev_mod) > th
+        if phase_conv is not None:
+            phase_conv.phase = phase
+            phase_conv.gained = gained
+            convergence.append(phase_conv)
+            if tracer.emitter is not None:  # to_dict is ~CAP row dicts
+                tracer.event("convergence", **phase_conv.to_dict())
         if gained:
             dense, nc = renumber_communities(comm_old)
             comm_all = dense[comm_all]
@@ -1761,6 +1921,7 @@ def louvain_phases(
                       f"time: {t2 - t1:.3f}s")
             if one_phase:
                 prev_mod = curr_mod
+                tracer.end_span(_phase_sid, gained=True)
                 break
             if slabless:
                 # Device plans + old phase state die before the coarsen
@@ -1819,6 +1980,8 @@ def louvain_phases(
                     g = pending_dg.graph  # SlabMeta: scalar facts only
                 else:
                     g = coarsen_graph(g, dense, nc)
+            tracer.event("coarsen", nv_from=g_nv, ne_from=g_ne, nv_to=nc,
+                         device=bool(dev_transition))
             prev_mod = curr_mod
             phase += 1
             if checkpoint_dir:
@@ -1843,6 +2006,7 @@ def louvain_phases(
                         orig_ne=graph.num_edges,
                         fingerprint=ck_fp,
                     ))
+            tracer.end_span(_phase_sid, gained=True)
         else:
             # Safety net: when cycling exits early, run one final 1e-6 pass
             # (main.cpp:432-442).  Note: lower must be -1 (not prev_mod), or
@@ -1860,7 +2024,15 @@ def louvain_phases(
                             dg, comm_pad, device_slab=_runner_slab(runner))
                 tot_iters += iters
                 comm_old = comm_pad[dg.old_to_pad]
-                if (curr_mod - prev_mod) > 1.0e-6:
+                final_gained = (curr_mod - prev_mod) > 1.0e-6
+                pc_final = getattr(runner, "convergence", None)
+                if pc_final is not None:
+                    pc_final.phase = phase
+                    pc_final.gained = final_gained
+                    convergence.append(pc_final)
+                    if tracer.emitter is not None:
+                        tracer.event("convergence", **pc_final.to_dict())
+                if final_gained:
                     dense, nc = renumber_communities(comm_old)
                     comm_all = dense[comm_all]
                     prev_mod = curr_mod
@@ -1869,10 +2041,12 @@ def louvain_phases(
                         num_vertices=g_nv, num_edges=g_ne,
                         seconds=time.perf_counter() - t1,
                     ))
+            tracer.end_span(_phase_sid, gained=False)
             break
 
     if diag:
         diag.close()
+    tracer.set_phase(None)
     # Final contiguous renumber of the composed labels (main.cpp:374-394).
     dense_all, _ = renumber_communities(comm_all)
     return LouvainResult(
@@ -1883,4 +2057,5 @@ def louvain_phases(
         total_seconds=time.perf_counter() - t_start,
         pallas_coverage=(cov_num / cov_den) if cov_den else None,
         pallas_width_hits=width_hits or None,
+        convergence=convergence,
     )

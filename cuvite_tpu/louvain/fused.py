@@ -37,7 +37,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from cuvite_tpu.core.types import MAX_TOTAL_ITERATIONS
+from cuvite_tpu.core.types import CONV_ROWS_CAP, MAX_TOTAL_ITERATIONS
 from cuvite_tpu.louvain.step import louvain_step_local
 from cuvite_tpu.ops import segment as seg
 
@@ -97,13 +97,20 @@ def fused_louvain(src, dst, w, thresholds, constant, real_mask, *,
     still-large graph and compact it on host before continuing.
 
     Returns (labels [nv_pad], modularity, n_phases, total_iters,
-    mod_hist [max_phases], iter_hist [max_phases], nc_hist [max_phases]).
+    mod_hist [max_phases], iter_hist [max_phases], nc_hist [max_phases],
+    cq_hist [max_phases, CONV_ROWS_CAP], cmoved_hist [same]) — the last
+    two are the per-phase convergence telemetry (ISSUE 6): per-iteration
+    modularity and moved-vertex rows accumulated by _run_phase_loop's
+    device buffers, scattered into the gaining phase's slot.  They ride
+    the same single host sync as the stat vectors.
     """
     wdt = w.dtype
     labels0 = jnp.arange(nv_pad, dtype=jnp.int32)
     mod_hist0 = jnp.zeros(max_phases, dtype=wdt)
     iter_hist0 = jnp.zeros(max_phases, dtype=jnp.int32)
     nc_hist0 = jnp.zeros(max_phases, dtype=jnp.int32)
+    cq_hist0 = jnp.zeros((max_phases, CONV_ROWS_CAP), dtype=wdt)
+    cmoved_hist0 = jnp.zeros((max_phases, CONV_ROWS_CAP), dtype=jnp.int32)
     lower = jnp.asarray(-1.0, dtype=wdt)
     prev0 = lower if prev_mod0 is None else jnp.asarray(prev_mod0, dtype=wdt)
     budget = (jnp.int32(max_phases) if phase_budget is None
@@ -128,10 +135,11 @@ def fused_louvain(src, dst, w, thresholds, constant, real_mask, *,
 
     def body(state):
         (src, dst, w, labels, prev_mod, phase, tot_iters,
-         mod_hist, iter_hist, nc_hist, _, _done) = state
+         mod_hist, iter_hist, nc_hist, cq_hist, cmoved_hist,
+         _, _done) = state
         vdeg = seg.segment_sum(w, src, num_segments=nv_pad, sorted_ids=True)
         th = thresholds[jnp.minimum(phase, max_phases - 1)]
-        past, mod, iters, _ = _phase_iterations(
+        past, mod, iters, _, (cq, cmoved, _covf) = _phase_iterations(
             src, dst, w, vdeg, constant, th, lower,
             nv_pad=nv_pad, accum_dtype=accum_dtype,
             max_iters=MAX_TOTAL_ITERATIONS,
@@ -169,18 +177,23 @@ def fused_louvain(src, dst, w, thresholds, constant, real_mask, *,
             nc_hist.at[jnp.minimum(phase, max_phases - 1)].set(
                 count_comms(labels2)),
             nc_hist)
+        slot = jnp.minimum(phase, max_phases - 1)
+        cq_hist = jnp.where(gained, cq_hist.at[slot].set(cq), cq_hist)
+        cmoved_hist = jnp.where(
+            gained, cmoved_hist.at[slot].set(cmoved), cmoved_hist)
 
         phase2 = jnp.where(gained, phase + 1, phase)
         done = (~gained) | (phase2 >= budget) | (tot_iters > it_budget)
         return (src2, dst2, w2, labels2, prev_mod2, phase2, tot_iters,
-                mod_hist, iter_hist, nc_hist, gained, done)
+                mod_hist, iter_hist, nc_hist, cq_hist, cmoved_hist,
+                gained, done)
 
     init = (src, dst, w, labels0, prev0, jnp.int32(0), jnp.int32(0),
-            mod_hist0, iter_hist0, nc_hist0, jnp.bool_(False),
-            jnp.bool_(False))
+            mod_hist0, iter_hist0, nc_hist0, cq_hist0, cmoved_hist0,
+            jnp.bool_(False), jnp.bool_(False))
     (src_f, dst_f, w_f, labels, prev_mod, phase, tot_iters,
-     mod_hist, iter_hist, nc_hist, last_gained, _) = jax.lax.while_loop(
-        cond, body, init)
+     mod_hist, iter_hist, nc_hist, cq_hist, cmoved_hist, last_gained,
+     _) = jax.lax.while_loop(cond, body, init)
 
     if cycling:
         # Safety-net final 1e-6 pass, ONLY when the loop exited because a
@@ -193,10 +206,10 @@ def fused_louvain(src, dst, w, thresholds, constant, real_mask, *,
 
         def extra(args):
             labels, prev_mod, tot_iters, mod_hist, iter_hist, nc_hist, \
-                phase = args
+                cq_hist, cmoved_hist, phase = args
             vdeg = seg.segment_sum(w_f, src_f, num_segments=nv_pad,
                                    sorted_ids=True)
-            past, mod, iters, _ = _phase_iterations(
+            past, mod, iters, _, (cq, cmoved, _covf) = _phase_iterations(
                 src_f, dst_f, w_f, vdeg, constant,
                 jnp.asarray(1e-6, dtype=wdt), lower,
                 nv_pad=nv_pad, accum_dtype=accum_dtype,
@@ -214,15 +227,18 @@ def fused_louvain(src, dst, w, thresholds, constant, real_mask, *,
                 jnp.where(gained, iter_hist.at[slot].set(iters), iter_hist),
                 jnp.where(gained, nc_hist.at[slot].set(count_comms(labels2)),
                           nc_hist),
+                jnp.where(gained, cq_hist.at[slot].set(cq), cq_hist),
+                jnp.where(gained, cmoved_hist.at[slot].set(cmoved),
+                          cmoved_hist),
                 jnp.where(gained, phase + 1, phase),
             )
 
         (labels, prev_mod, tot_iters, mod_hist, iter_hist, nc_hist,
-         phase) = jax.lax.cond(
+         cq_hist, cmoved_hist, phase) = jax.lax.cond(
             run_extra, extra, lambda a: a,
             (labels, prev_mod, tot_iters, mod_hist, iter_hist, nc_hist,
-             phase),
+             cq_hist, cmoved_hist, phase),
         )
 
     return (labels, prev_mod, phase, tot_iters, mod_hist, iter_hist,
-            nc_hist)
+            nc_hist, cq_hist, cmoved_hist)

@@ -1,0 +1,48 @@
+"""cuvite_tpu.obs — the flight recorder (ISSUE 6).
+
+Structured observability for every Louvain run, in four pieces:
+
+  * ``events``        — span/event JSONL trace (sinks, SpanEmitter,
+                        round-trip readers/validators);
+  * ``compile_watch`` — the reusable XLA compile watcher (promoted out
+                        of workloads/bench.py);
+  * ``memory``        — the per-buffer HBM ledger + RSS + opt-in
+                        jax.profiler hooks;
+  * ``convergence``   — host decode of the device phase-loop telemetry
+                        (per-iteration Q / moved / overflow rows);
+  * ``recorder``      — FlightRecorder bundling the above behind one
+                        context manager, attached to runs via
+                        ``utils.trace.Tracer(recorder=...)``.
+
+Everything except ``recorder.__enter__``'s watcher/profiler hooks is
+stdlib-only: importable (and cheap) in bare CI containers.
+"""
+
+from cuvite_tpu.obs.compile_watch import CompileWatcher
+from cuvite_tpu.obs.convergence import (
+    MOVED_UNTRACKED,
+    ConvRow,
+    PhaseConvergence,
+    convergence_summary,
+    decode_phase_conv,
+)
+from cuvite_tpu.obs.events import (
+    TRACE_VERSION,
+    JsonlTraceSink,
+    MemoryTraceSink,
+    SpanEmitter,
+    TraceSink,
+    read_trace,
+    spans_of,
+    validate_trace,
+)
+from cuvite_tpu.obs.memory import DeviceMemoryLedger, save_memory_profile
+from cuvite_tpu.obs.recorder import NO_TRACE, FlightRecorder
+
+__all__ = [
+    "CompileWatcher", "ConvRow", "DeviceMemoryLedger", "FlightRecorder",
+    "JsonlTraceSink", "MemoryTraceSink", "MOVED_UNTRACKED", "NO_TRACE",
+    "PhaseConvergence", "SpanEmitter", "TraceSink", "TRACE_VERSION",
+    "convergence_summary", "decode_phase_conv",
+    "read_trace", "save_memory_profile", "spans_of", "validate_trace",
+]

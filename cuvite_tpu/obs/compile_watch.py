@@ -1,0 +1,110 @@
+"""Reusable XLA compile watcher (promoted out of workloads/bench.py).
+
+The bench harness grew a compile guard in round 6 so a number requiring
+mid-measurement compilation could never enter a record; the same signal
+— jax's ``Compiling <module> ...`` / ``Finished XLA compilation of
+<module> in <secs> sec`` warnings under ``jax_log_compiles`` — is the
+only visibility any run has into XLA compile cost, not just benches.
+This module makes it a subscriber any caller can install: the driver
+wires it to the trace sink (every compile becomes a ``compile`` event
+with module name + duration), and the bench keeps using ``compiles`` as
+its abort signal.
+
+Nesting-safe: the prior ``jax_log_compiles`` value is restored on exit,
+so a watcher inside a watched region (a traced run under the bench
+guard) does not silently disarm the outer watcher.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+_FINISHED_RE = re.compile(
+    r"Finished XLA compilation of (.+?) in ([0-9.eE+-]+) sec")
+
+
+def _module_of(compiling_msg: str) -> str:
+    # "Compiling <name> with global shapes and types [...]" (pxla).
+    body = compiling_msg.split("Compiling ", 1)[-1]
+    return body.split(" with global shapes", 1)[0].strip()
+
+
+class CompileWatcher(logging.Handler):
+    """Collects XLA compile activity while active.
+
+    ``compiles``: the raw ``Compiling ...`` messages (the bench guard's
+    abort signal — identical semantics to the historical in-bench
+    watcher and ``test_no_recompile_on_second_run``).
+    ``events``: one dict per compile, ``{"module": name, "dur_s": secs}``
+    (``dur_s`` is None when no matching completion message arrived,
+    e.g. a compile still in flight at exit).  ``on_event`` (optional
+    callable) receives each completed event as it happens — the trace
+    subscriber hook.
+    """
+
+    def __init__(self, on_event=None):
+        super().__init__(level=logging.WARNING)
+        self.compiles: list = []
+        self.events: list = []
+        self.on_event = on_event
+        self._pending: list = []  # modules compiling, completion not seen
+
+    def emit(self, record):
+        msg = record.getMessage()
+        if "Compiling " in msg:
+            self.compiles.append(msg)
+            self._pending.append(_module_of(msg))
+            return
+        m = _FINISHED_RE.search(msg)
+        if m:
+            name, secs = m.group(1), float(m.group(2))
+            # Pair the completion with its pending compile: exact name
+            # first, else the LONGEST pending substring (completion says
+            # "jit(<name>)").  Oldest-first substring matching let a
+            # module whose name prefixes another ('step' vs 'step2')
+            # steal the wrong completion and leave a phantom
+            # dur_s=None event for the real one at exit.
+            if name in self._pending:
+                self._pending.remove(name)
+            else:
+                hits = [p for p in self._pending if p in name]
+                if hits:
+                    self._pending.remove(max(hits, key=len))
+            self._record({"module": name, "dur_s": secs})
+
+    def _record(self, ev: dict) -> None:
+        self.events.append(ev)
+        if self.on_event is not None:
+            self.on_event(ev)
+
+    def __enter__(self):
+        import jax
+
+        self._logger = logging.getLogger("jax")
+        # Keep the compile chatter off stderr while watching: jax's own
+        # StreamHandler lives directly on the 'jax' logger — mute it for
+        # the window (restored on exit).  Other CompileWatchers are NOT
+        # muted: a nested watcher must leave the outer one recording
+        # (the nesting-safe contract above).
+        self._muted = [(h, h.level) for h in self._logger.handlers
+                       if h is not self and not isinstance(h, CompileWatcher)]
+        for h, _ in self._muted:
+            h.setLevel(logging.CRITICAL)
+        self._logger.addHandler(self)
+        self._prior_flag = bool(jax.config.jax_log_compiles)
+        jax.config.update("jax_log_compiles", True)
+        return self
+
+    def __exit__(self, *exc):
+        import jax
+
+        jax.config.update("jax_log_compiles", self._prior_flag)
+        self._logger.removeHandler(self)
+        for h, lvl in self._muted:
+            h.setLevel(lvl)
+        # Compiles whose completion never arrived still become events.
+        for pend in self._pending:
+            self._record({"module": pend, "dur_s": None})
+        self._pending = []
+        return False

@@ -1,0 +1,148 @@
+"""Per-phase convergence telemetry: the curves the literature tunes on.
+
+The parallel-Louvain line (Ghosh et al., arXiv:1410.1237; Staudt &
+Meyerhenke, arXiv:1304.4453) drives its heuristics — early termination,
+coloring schedules, threshold cycling — off per-iteration convergence
+curves: modularity gain and moved-vertex counts.  Our jitted phase loops
+compute exactly those values every iteration and used to throw them
+away, because fetching them per iteration would cost one blocking
+device->host sync each (the thing the on-device loop exists to avoid).
+
+The loops now accumulate one (Q, moved, overflow) row per iteration into
+fixed-size device buffers (``core.types.CONV_ROWS_CAP`` rows) carried
+through the ``lax.while_loop``; the buffers ride the EXISTING one-sync-
+per-phase scalar fetch (driver.py::_phase_sync), so telemetry adds zero
+host syncs.  This module is the host-side decode: raw buffers ->
+:class:`PhaseConvergence` rows (surfaced as ``LouvainResult.convergence``
+and emitted as ``convergence`` trace events).
+
+Stdlib-only (no jax import): decoding operates on host arrays the sync
+already fetched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# Sentinel for "not tracked": host-loop schedules (coloring / class
+# plans) know per-iteration Q from their existing per-iteration sync but
+# never fetch the moved count (doing so would add syncs); their rows
+# carry this instead of a real count.
+MOVED_UNTRACKED = -1
+
+
+@dataclasses.dataclass
+class ConvRow:
+    """One iteration of one phase."""
+
+    iteration: int
+    # Modularity of this iteration's INPUT assignment (what the step
+    # computes — step.py's StepOut.modularity): row i's moves show up in
+    # row i+1's q.  Row 0's q is the phase's starting assignment; the
+    # phase's RESULTING modularity is the driver's scalar sync, not
+    # rows[-1].q (the final sweep is the one that failed the threshold).
+    q: float
+    moved: int             # vertices THIS iteration moved (-1: untracked)
+    overflow: bool = False  # sparse-exchange budget overflow this sweep
+
+    def to_dict(self) -> dict:
+        return {"iteration": self.iteration, "q": self.q,
+                "moved": self.moved, "overflow": self.overflow}
+
+
+@dataclasses.dataclass
+class PhaseConvergence:
+    """Per-iteration convergence rows of one phase attempt.
+
+    ``gained`` — whether the phase beat the threshold and entered the
+    result's phase list (the final attempt of a run typically does not).
+    ``truncated`` — the phase ran more iterations than CONV_ROWS_CAP;
+    rows beyond the cap were dropped on device (``rows`` holds the first
+    CAP iterations; the scalar iteration count is still exact).
+    """
+
+    phase: int
+    rows: list           # list[ConvRow]
+    iterations: int      # exact device count (may exceed len(rows))
+    truncated: bool = False
+    gained: bool | None = None
+
+    def dq(self) -> list:
+        """Per-iteration modularity gains.  Because ``q`` is the INPUT-
+        assignment modularity, ``dq()[i] = q[i] - q[i-1]`` is the gain
+        realized by iteration i-1's moves (pair it with ``rows[i-1].
+        moved``, not ``rows[i].moved``); None for row 0 — no earlier
+        iteration of this phase produced its assignment."""
+        out = []
+        for i, r in enumerate(self.rows):
+            out.append(None if i == 0 else r.q - self.rows[i - 1].q)
+        return out
+
+    def moved_total(self) -> int | None:
+        """Total moved vertices, or None when any row is untracked."""
+        if any(r.moved == MOVED_UNTRACKED for r in self.rows):
+            return None
+        return sum(r.moved for r in self.rows)
+
+    def to_dict(self) -> dict:
+        return {
+            "phase": self.phase,
+            "iterations": self.iterations,
+            "truncated": self.truncated,
+            "gained": self.gained,
+            "rows": [r.to_dict() for r in self.rows],
+        }
+
+    def summary(self) -> dict:
+        """Compact per-phase digest (bench schema v4's
+        ``convergence_summary`` entries): endpoints instead of the full
+        curve, so a record stays small at any iteration count.
+        ``q_first``/``q_last`` keep the rows' input-assignment semantics
+        (q_last is the final sweep's STARTING Q; the phase's resulting
+        modularity lives in ``PhaseStats.modularity``)."""
+        first = self.rows[0] if self.rows else None
+        last = self.rows[-1] if self.rows else None
+        mt = self.moved_total()
+        return {
+            "phase": self.phase,
+            "iterations": self.iterations,
+            "q_first": None if first is None else first.q,
+            "q_last": None if last is None else last.q,
+            "moved_first": None if first is None else first.moved,
+            "moved_total": mt,
+            "truncated": self.truncated,
+            "gained": self.gained,
+        }
+
+
+def decode_phase_conv(phase: int, iterations: int, q_rows, moved_rows=None,
+                      ovf_rows=None, gained=None) -> PhaseConvergence:
+    """Host decode of the device conv buffers for one phase.
+
+    ``q_rows``/``moved_rows``/``ovf_rows`` are the synced fixed-size
+    buffers (length CONV_ROWS_CAP); only the first min(iterations, CAP)
+    rows are meaningful.  ``moved_rows=None`` marks an untracked
+    schedule (host color loops)."""
+    cap = len(q_rows)
+    n = min(int(iterations), cap)
+    rows = []
+    for i in range(n):
+        rows.append(ConvRow(
+            iteration=i,
+            q=float(q_rows[i]),
+            moved=(MOVED_UNTRACKED if moved_rows is None
+                   else int(moved_rows[i])),
+            overflow=bool(ovf_rows[i]) if ovf_rows is not None else False,
+        ))
+    return PhaseConvergence(
+        phase=phase, rows=rows, iterations=int(iterations),
+        truncated=int(iterations) > cap, gained=gained,
+    )
+
+
+def convergence_summary(convergence) -> list:
+    """Bench schema v4 ``convergence_summary``: one digest per phase
+    attempt (empty list when the run carried no telemetry)."""
+    if not convergence:
+        return []
+    return [pc.summary() for pc in convergence]

@@ -1,0 +1,92 @@
+"""Device-memory (HBM) ledger + host RSS accounting + profiler hooks.
+
+The reference tracks only the host high-water (getrusage ru_maxrss,
+/root/reference/main.cpp:142-150).  On TPU the number that actually
+gates scale is per-chip HBM — the round-8 finding was that the
+replicated exchange's O(nv_total) per-chip tables, not transport, bind
+the sparse cutover — and XLA gives no per-buffer attribution for the
+arrays a driver uploads.  The ledger closes that gap at the level the
+driver controls: every logical buffer the PhaseRunner/fused driver
+places (slab, tables, plans, exchange routing) is recorded by category
+with its ``nbytes``, snapshotted at phase boundaries, and the per-
+category peak survives the run (bench schema v4's
+``hbm_peak_by_buffer``).
+
+Byte counts are LOGICAL global sizes (``arr.nbytes`` of the placed
+array): what the driver asked for, before any XLA padding/donation —
+i.e. the number a capacity model needs, not an allocator dump.  The
+opt-in ``jax.profiler`` hooks below are the allocator-truth complement.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class DeviceMemoryLedger:
+    """Per-category device-buffer byte accounting.
+
+    ``begin_phase()`` clears the live set (a new PhaseRunner replaces
+    the previous phase's buffers); ``track(category, *arrays)`` adds the
+    nbytes of anything array-like (None and scalars are ignored);
+    ``snapshot(phase)`` returns the live totals and folds them into the
+    running per-category peaks (``peak_by_buffer``).
+    """
+
+    CATEGORIES = ("slab", "tables", "plans", "exchange", "scratch")
+
+    def __init__(self):
+        self.live: dict = {}
+        self.peak_by_buffer: dict = {}
+        self.snapshots: list = []
+
+    def begin_phase(self) -> None:
+        self.live = {}
+
+    def track(self, category: str, *arrays) -> None:
+        n = 0
+        for a in arrays:
+            if a is None:
+                continue
+            nb = getattr(a, "nbytes", None)
+            if nb:
+                n += int(nb)
+        if n:
+            self.live[category] = self.live.get(category, 0) + n
+
+    def track_nbytes(self, category: str, nbytes: int) -> None:
+        if nbytes:
+            self.live[category] = self.live.get(category, 0) + int(nbytes)
+
+    def snapshot(self, phase=None) -> dict:
+        from cuvite_tpu.utils.trace import rss_high_water_mb
+
+        by_buffer = dict(self.live)
+        for k, v in by_buffer.items():
+            if v > self.peak_by_buffer.get(k, 0):
+                self.peak_by_buffer[k] = v
+        snap = {
+            "phase": phase,
+            "by_buffer": by_buffer,
+            "total": sum(by_buffer.values()),
+            "rss_mb": round(rss_high_water_mb(), 1),
+        }
+        self.snapshots.append(snap)
+        return snap
+
+
+def save_memory_profile(profile_dir: str | None, tag: str) -> str | None:
+    """Opt-in ``jax.profiler.save_device_memory_profile`` snapshot (pprof
+    format) under ``profile_dir``; returns the path, or None when
+    disabled or the profiler is unavailable on this backend."""
+    if not profile_dir:
+        return None
+    import jax
+
+    os.makedirs(profile_dir, exist_ok=True)
+    path = os.path.join(profile_dir, f"memory.{tag}.prof")
+    try:
+        jax.profiler.save_device_memory_profile(path)
+    except Exception:  # backend without memory profiling: opt-in, so soft
+        return None
+    return path

@@ -31,7 +31,15 @@ def rss_high_water_mb() -> float:
 
 
 class Tracer:
-    """Accumulating named stage timers + counters.
+    """Accumulating named stage timers + counters, and (since ISSUE 6) a
+    thin facade over the obs flight recorder: attach a
+    ``cuvite_tpu.obs.FlightRecorder`` and every ``stage()`` window also
+    becomes a nested span in the structured trace, ``event()`` /
+    ``begin_span()`` / ``track()`` forward to the emitter/HBM ledger, and the
+    drivers' telemetry (convergence rows, exchange-plan stats, memory
+    snapshots) lands in the record stream.  Without a recorder those
+    calls are no-ops — the drivers thread them unconditionally at zero
+    cost.
 
     Usage::
 
@@ -42,8 +50,12 @@ class Tracer:
         print(tr.report())
     """
 
-    def __init__(self, enabled: bool = True):
-        self.enabled = enabled
+    def __init__(self, enabled: bool = True, recorder=None):
+        # A recorder implies recording: --trace-out without --trace must
+        # still time the stages its spans report.
+        self.enabled = enabled or recorder is not None
+        self.recorder = recorder
+        self.emitter = recorder.emitter if recorder is not None else None
         self.times: dict[str, float] = {}
         self.calls: dict[str, int] = {}
         self.counters: dict[str, float] = {}
@@ -53,17 +65,60 @@ class Tracer:
         if not self.enabled:
             yield
             return
+        em = self.emitter
+        sid = em.begin(name) if em is not None else None
         t0 = time.perf_counter()
         try:
             yield
         finally:
             dt = time.perf_counter() - t0
+            if em is not None:
+                em.end(sid, dur_s=dt)
             self.times[name] = self.times.get(name, 0.0) + dt
             self.calls[name] = self.calls.get(name, 0) + 1
 
     def count(self, name: str, value: float = 1) -> None:
         if self.enabled:
             self.counters[name] = self.counters.get(name, 0) + value
+
+    # -- flight-recorder facade (no-ops without an attached recorder) -------
+
+    def event(self, name: str, **attrs) -> None:
+        """Point event in the structured trace."""
+        if self.emitter is not None:
+            self.emitter.event(name, **attrs)
+
+    def begin_span(self, name: str, **attrs):
+        """Open a span whose extent cannot be a ``with`` block (the
+        driver's per-phase envelope spans a loop body with breaks).
+        Returns an opaque handle for :meth:`end_span`."""
+        if self.emitter is not None:
+            return self.emitter.begin(name, **attrs)
+        return None
+
+    def end_span(self, handle, **attrs) -> None:
+        if self.emitter is not None and handle is not None:
+            self.emitter.end(handle, **attrs)
+
+    def set_phase(self, phase) -> None:
+        """Tag subsequent records with the running phase index."""
+        if self.emitter is not None:
+            self.emitter.phase = phase
+
+    def track(self, category: str, *arrays) -> None:
+        """Account device buffers to the HBM ledger by category."""
+        if self.recorder is not None:
+            self.recorder.ledger.track(category, *arrays)
+
+    def ledger_phase_begin(self) -> None:
+        if self.recorder is not None:
+            self.recorder.ledger.begin_phase()
+
+    def ledger_snapshot(self, phase=None) -> None:
+        """Snapshot the ledger at a phase boundary and emit it."""
+        if self.recorder is not None:
+            snap = self.recorder.ledger.snapshot(phase)
+            self.event("hbm", **snap)
 
     # Stage names the drivers use, in pipeline order.  The first three are
     # the bench record's REQUIRED per-stage fields (ISSUE 3 satellite):
@@ -78,11 +133,16 @@ class Tracer:
         """Per-stage seconds for machine consumers (the bench JSON's
         ``stages`` field): always carries ``<stage>_s`` for every
         CANONICAL_STAGES entry (0.0 when the stage never ran), plus any
-        other recorded stage under the same naming."""
-        out = {k + "_s": round(self.times.get(k, 0.0), 3)
+        other recorded stage under the same naming.
+
+        FULL precision: rounding here (the historical ``round(v, 3)``)
+        erased sub-millisecond stages outright — upload on a tiny graph
+        reported 0.0, making real-vs-absent indistinguishable to the
+        regression gate.  Human-facing rounding lives in ``report()``."""
+        out = {k + "_s": self.times.get(k, 0.0)
                for k in self.CANONICAL_STAGES}
         for k, v in sorted(self.times.items()):
-            out.setdefault(k + "_s", round(v, 3))
+            out.setdefault(k + "_s", v)
         return out
 
     def teps(self) -> float:

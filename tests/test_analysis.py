@@ -341,6 +341,45 @@ def launch(kernel, cT, tile):
 """,
         "cuvite_tpu/kernels/fake_r011.py",
     ),
+    (
+        "R012",
+        """
+import time
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    return x * 2.0
+
+def bench(x):
+    t0 = time.perf_counter()
+    y = step(jnp.asarray(x))
+    dt = time.perf_counter() - t0  # async dispatch: times the launch
+    return y, dt
+""",
+        """
+import time
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x):
+    return x * 2.0
+
+def bench(x, opaque_fn):
+    t0 = time.perf_counter()
+    y = jax.block_until_ready(step(jnp.asarray(x)))
+    dt = time.perf_counter() - t0
+    # Opaque callables are out of scope: they may sync internally
+    # (louvain_phases does), and flagging them would bury the signal.
+    t0 = time.perf_counter()
+    opaque_fn()
+    dt2 = time.perf_counter() - t0
+    return y, dt, dt2
+""",
+        "tools/fake_r012.py",
+    ),
 ]
 
 RULE_IDS = [c[0] for c in RULE_CASES]
@@ -397,6 +436,54 @@ def test_r003_scope_is_device_path_only():
     # the SAME source outside louvain/kernels/ops is out of scope
     assert not any(f.rule == "R003"
                    for f in run_source(bad, rel="cuvite_tpu/io/x.py"))
+
+
+def test_r012_sync_before_dispatch_is_not_evidence():
+    # A host-value int() BEFORE the dispatch forces nothing: the window
+    # still times only the async launch and must be flagged.
+    bad = """
+import time
+import jax.numpy as jnp
+
+def bench(a, b, nv):
+    t0 = time.perf_counter()
+    n = int(nv)
+    y = jnp.dot(a, b)
+    dt = time.perf_counter() - t0
+    return y, n, dt
+"""
+    assert any(f.rule == "R012"
+               for f in run_source(bad, rel="tools/x.py"))
+    # Same-line wrapping IS evidence: float(jnp.dot(...)) blocks on the
+    # result before the window closes.
+    good = """
+import time
+import jax.numpy as jnp
+
+def bench(a, b):
+    t0 = time.perf_counter()
+    y = float(jnp.dot(a, b))
+    dt = time.perf_counter() - t0
+    return y, dt
+"""
+    assert not any(f.rule == "R012"
+                   for f in run_source(good, rel="tools/x.py"))
+    # A wrapped readback whose argument spans lines still forces the
+    # dispatch it encloses (normal 79-char wrapping must not flag).
+    wrapped = """
+import time
+import jax
+import jax.numpy as jnp
+
+def bench(a, b):
+    t0 = time.perf_counter()
+    y = jax.block_until_ready(
+        jnp.dot(a, b))
+    dt = time.perf_counter() - t0
+    return y, dt
+"""
+    assert not any(f.rule == "R012"
+                   for f in run_source(wrapped, rel="tools/x.py"))
 
 
 R008_GUARD = """

@@ -70,7 +70,7 @@ def test_threshold_decision_follows_ds():
     th = np.float32(exact - q32)  # ds gain = 32 >= th > f32 gain
 
     def iters(accum):
-        _, _, it, _ = _run_phase_loop(
+        _, _, it, _, _conv = _run_phase_loop(
             (), jnp.zeros(4, jnp.int32), th, lower,
             call=make_call(accum), max_iters=5)
         return int(it)

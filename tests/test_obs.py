@@ -1,0 +1,539 @@
+"""Flight-recorder tests (ISSUE 6): the observability acceptance gates.
+
+The three properties that make telemetry trustworthy enough to leave on:
+
+  * free when off, cheap when on — labels are BIT-IDENTICAL with and
+    without a recorder attached (both engines, both exchanges, rmat-14),
+    and the device phase loops still sync exactly once per phase (a
+    host-sync spy counts jax.device_get calls — per-iteration syncs are
+    the thing the on-device loop exists to avoid);
+  * the trace round-trips — every span closes, phase spans nest the
+    iterate stage and the convergence/exchange events, the per-iteration
+    Q rows in the trace match ``LouvainResult.convergence``, and a cold
+    run records at least one XLA compile event;
+  * the regression gate bites — ``tools/perf_regress.py`` flags an
+    injected 30% TEPS drop against the checked-in BENCH trajectory,
+    passes on the real trajectory, and its ``--self-check`` (run here,
+    in tier-1) refuses a malformed checked-in record.
+"""
+
+import contextlib
+import json
+import logging
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from cuvite_tpu.io.generate import generate_rmat
+from cuvite_tpu.louvain.driver import louvain_phases
+from cuvite_tpu.obs import (
+    CompileWatcher,
+    DeviceMemoryLedger,
+    FlightRecorder,
+    JsonlTraceSink,
+    MemoryTraceSink,
+    MOVED_UNTRACKED,
+    SpanEmitter,
+    convergence_summary,
+    decode_phase_conv,
+    read_trace,
+    spans_of,
+    validate_trace,
+)
+from cuvite_tpu.utils.trace import Tracer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PERF_REGRESS = os.path.join(REPO, "tools", "perf_regress.py")
+
+
+@pytest.fixture(scope="module")
+def rmat14():
+    return generate_rmat(14, edge_factor=8, seed=3)
+
+
+# ---------------------------------------------------------------------------
+# Trace round-trip (FIRST in the module: this run owns the cold compiles
+# for its unique graph shape, so the compile-event assertion is sound in
+# a single-process tier-1 run).
+
+
+def test_trace_round_trip_cold_run(tmp_path):
+    g = generate_rmat(13, edge_factor=12, seed=7)  # shape unique to this test
+    path = str(tmp_path / "run.jsonl")
+    with FlightRecorder(JsonlTraceSink(path)) as rec:
+        res = louvain_phases(g, tracer=Tracer(recorder=rec))
+    records = read_trace(path)
+    assert validate_trace(records) == [], validate_trace(records)[:5]
+    assert records[0]["t"] == "run_begin" and records[-1]["t"] == "run_end"
+
+    # Phase spans nest the iterate stage and the telemetry events.
+    phase_spans = spans_of(records, "phase")
+    assert len(phase_spans) == len(res.convergence) >= 2
+    for span in phase_spans:
+        assert span["end"] is not None
+        assert "iterate" in span["child_names"]
+        names = {e["name"] for e in span["events"]}
+        assert {"convergence", "exchange"} <= names, names
+
+    # Per-iteration Q rows in the trace match LouvainResult.convergence.
+    conv_events = [r for r in records if r.get("t") == "event"
+                   and r.get("name") == "convergence"]
+    assert len(conv_events) == len(res.convergence)
+    for ev, pc in zip(conv_events, res.convergence):
+        assert ev["attrs"]["phase"] == pc.phase
+        assert ev["attrs"]["iterations"] == pc.iterations
+        assert ev["attrs"]["rows"] == [r.to_dict() for r in pc.rows]
+        qs = [row["q"] for row in ev["attrs"]["rows"]]
+        assert qs == [r.q for r in pc.rows]
+        # The curve is non-decreasing over the ACCEPTED iterations; the
+        # final row is the attempt that failed the threshold and may dip.
+        assert all(b >= a - 1e-6 for a, b in zip(qs[:-1], qs[1:-1]))
+
+    # Cold run: the compile watcher recorded the fresh XLA compiles.
+    compile_events = [r for r in records if r.get("t") == "event"
+                      and r.get("name") == "compile"]
+    assert compile_events, "cold run must record at least one compile"
+    assert all("module" in e["attrs"] for e in compile_events)
+
+    # HBM ledger snapshots rode along.
+    hbm = [r for r in records if r.get("t") == "event"
+           and r.get("name") == "hbm"]
+    assert len(hbm) >= len(res.phases)
+    assert all(isinstance(e["attrs"]["by_buffer"], dict) for e in hbm)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry is free: bit-identical labels, both engines, both exchanges.
+
+
+@pytest.mark.parametrize("engine,exchange,nshards", [
+    ("bucketed", "sparse", 2),
+    ("bucketed", "replicated", 2),
+    ("fused", "auto", 1),
+], ids=["bucketed-sparse", "bucketed-replicated", "fused"])
+def test_labels_bit_identical_with_telemetry(rmat14, tmp_path, engine,
+                                             exchange, nshards):
+    kw = dict(engine=engine, exchange=exchange, nshards=nshards,
+              verbose=False)
+    res0 = louvain_phases(rmat14, **kw)
+    path = str(tmp_path / "t.jsonl")
+    with FlightRecorder(JsonlTraceSink(path)) as rec:
+        res1 = louvain_phases(rmat14, tracer=Tracer(recorder=rec), **kw)
+    assert np.array_equal(res0.communities, res1.communities), \
+        "telemetry changed the clustering"
+    assert res0.modularity == res1.modularity
+    assert validate_trace(read_trace(path)) == []
+    # The telemetry run carries per-phase convergence; the off run too
+    # (the buffers ride the existing sync whether or not anyone listens).
+    assert len(res1.convergence) >= len(res1.phases)
+    assert [pc.iterations for pc in res0.convergence] \
+        == [pc.iterations for pc in res1.convergence]
+
+
+def test_convergence_rows_without_recorder(rmat14):
+    """LouvainResult.convergence is populated on a PLAIN run — the
+    device buffers ride the existing per-phase sync unconditionally."""
+    res = louvain_phases(rmat14, verbose=False)
+    assert res.convergence and len(res.convergence) >= len(res.phases)
+    for pc in res.convergence:
+        assert pc.iterations == len(pc.rows)  # far below CONV_ROWS_CAP
+        assert not pc.truncated
+        assert all(r.moved >= 0 for r in pc.rows)  # device loop tracks moved
+    gained = [pc for pc in res.convergence if pc.gained]
+    assert len(gained) == len(res.phases)
+    # Digests agree with the rows (the bench's convergence_summary path).
+    digests = convergence_summary(res.convergence)
+    for d, pc in zip(digests, res.convergence):
+        assert d["q_last"] == pc.rows[-1].q
+        assert d["moved_total"] == sum(r.moved for r in pc.rows)
+
+
+# ---------------------------------------------------------------------------
+# Cheap when on: exactly one device sync per phase, zero fresh compiles
+# on phases 2+.
+
+
+def test_one_device_sync_per_phase(rmat14, monkeypatch):
+    """The telemetry buffers ride THE existing per-phase sync: a spy on
+    jax.device_get sees exactly one call per phase attempt (the
+    _phase_sync chokepoint), never a per-iteration fetch."""
+    import cuvite_tpu.louvain.driver as drv
+
+    louvain_phases(rmat14, verbose=False)  # eat compiles outside the spy
+
+    gets = []
+    orig_get = jax.device_get
+
+    def spy(x):
+        gets.append(x)
+        return orig_get(x)
+
+    syncs = []
+    orig_sync = drv._phase_sync
+
+    def sync_spy(*a, **kw):
+        syncs.append(len(gets))
+        return orig_sync(*a, **kw)
+
+    monkeypatch.setattr(jax, "device_get", spy)
+    monkeypatch.setattr(drv, "_phase_sync", sync_spy)
+    with FlightRecorder() as rec:
+        res = louvain_phases(rmat14, tracer=Tracer(recorder=rec),
+                             verbose=False)
+    attempts = len(res.convergence)
+    total_iters = sum(pc.iterations for pc in res.convergence)
+    assert total_iters > attempts  # a per-iteration sync would be visible
+    assert len(syncs) == attempts
+    assert len(gets) == attempts, (
+        f"{len(gets)} device_get calls for {attempts} phase attempts "
+        f"({total_iters} iterations): telemetry added host syncs")
+
+
+class _PhaseProbe(Tracer):
+    """Recorder-attached tracer marking the compile-log length at each
+    iterate stage (the per-phase boundary)."""
+
+    def __init__(self, recorder, compile_log):
+        super().__init__(recorder=recorder)
+        self._log = compile_log
+        self.marks = []
+
+    @contextlib.contextmanager
+    def stage(self, name):
+        if name == "iterate":
+            self.marks.append(len(self._log))
+        with super().stage(name):
+            yield
+
+
+def test_zero_fresh_compiles_phases2plus_with_telemetry(rmat14):
+    """Telemetry must not break the compiled-step cache: with a recorder
+    attached, phases 2+ of an unchanged slab class compile nothing."""
+    compiles = []
+
+    class _Grab(logging.Handler):
+        def emit(self, record):
+            if "Compiling" in record.getMessage():
+                compiles.append(record.getMessage())
+
+    handler = _Grab(level=logging.WARNING)
+    logger = logging.getLogger("jax")
+    logger.addHandler(handler)
+    jax.config.update("jax_log_compiles", True)
+    try:
+        with FlightRecorder() as rec:
+            probe = _PhaseProbe(rec, compiles)
+            res = louvain_phases(rmat14, tracer=probe, verbose=False)
+    finally:
+        jax.config.update("jax_log_compiles", False)
+        logger.removeHandler(handler)
+    assert len(res.phases) >= 3 and len(probe.marks) >= 3
+    fresh = len(compiles) - probe.marks[2]
+    assert fresh == 0, (
+        f"phases 2+ compiled {fresh}x under telemetry: "
+        f"{compiles[probe.marks[2]:][:4]}")
+
+
+# ---------------------------------------------------------------------------
+# CLI export flags.
+
+
+def test_cli_trace_and_metrics_out(tmp_path, karate):
+    from cuvite_tpu.cli import main
+    from cuvite_tpu.io.vite import write_vite
+
+    p = str(tmp_path / "k.bin")
+    write_vite(p, karate)
+    trace = str(tmp_path / "k.jsonl")
+    metrics = str(tmp_path / "k.json")
+    rc = main(["--file", p, "--bits64", "--trace-out", trace,
+               "--metrics-out", metrics, "--quiet"])
+    assert rc == 0
+    records = read_trace(trace)
+    assert validate_trace(records) == []
+    assert spans_of(records, "phase")
+    m = json.load(open(metrics))
+    assert m["modularity"] > 0.40
+    assert m["convergence"] and m["convergence"][0]["rows"]
+    assert "hbm_peak_by_buffer" in m and "stages" in m
+    assert m["stages"]["iterate_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# obs unit surface: emitter nesting, ledger, convergence decode, watcher.
+
+
+def test_span_emitter_nesting_and_leak_unwind():
+    sink = MemoryTraceSink()
+    em = SpanEmitter(sink)
+    outer = em.begin("outer")
+    inner = em.begin("inner")
+    em.event("ping", k=1)
+    # Ending the OUTER span with the inner still open unwinds the leak.
+    em.end(outer)
+    em.close()
+    recs = sink.records
+    assert validate_trace(recs) == []
+    ev = next(r for r in recs if r.get("t") == "event")
+    assert ev["parent"] == inner and ev["attrs"] == {"k": 1}
+    leak = next(r for r in recs
+                if r.get("t") == "span_end" and r.get("id") == inner)
+    assert leak.get("leaked") is True
+
+
+def test_validate_trace_catches_violations():
+    base = {"wall": 0.0, "mono": 0.0, "host": 0}
+    unclosed = [dict(base, t="span_begin", id=1, parent=None, name="x")]
+    assert any("never closed" in p for p in validate_trace(unclosed))
+    orphan_parent = [dict(base, t="span_begin", id=2, parent=9, name="x")]
+    assert any("not open" in p for p in validate_trace(orphan_parent))
+    bad_end = [dict(base, t="span_end", id=3)]
+    assert any("unknown" in p for p in validate_trace(bad_end))
+    backwards = [dict(base, t="event", name="a", mono=2.0),
+                 dict(base, t="event", name="b", mono=1.0)]
+    assert any("backwards" in p for p in validate_trace(backwards))
+
+
+def test_memory_ledger_peaks_and_phases():
+    class Arr:
+        def __init__(self, nbytes):
+            self.nbytes = nbytes
+
+    led = DeviceMemoryLedger()
+    led.begin_phase()
+    led.track("slab", Arr(100), Arr(50), None)
+    led.track("tables", Arr(10))
+    snap = led.snapshot(0)
+    assert snap["by_buffer"] == {"slab": 150, "tables": 10}
+    assert snap["total"] == 160 and snap["rss_mb"] > 0
+    led.begin_phase()  # new phase replaces the live set
+    led.track("slab", Arr(80))
+    led.track("scratch", Arr(999))
+    led.snapshot(1)
+    assert led.peak_by_buffer == {"slab": 150, "tables": 10, "scratch": 999}
+    assert len(led.snapshots) == 2
+
+
+def test_decode_phase_conv_truncation():
+    q = [0.1, 0.2, 0.3, 0.3]
+    moved = [40, 20, 5, 0]
+    pc = decode_phase_conv(2, 3, q, moved)
+    assert pc.phase == 2 and not pc.truncated
+    assert [r.q for r in pc.rows] == [0.1, 0.2, 0.3]
+    assert pc.moved_total() == 65
+    assert pc.dq() == [None, pytest.approx(0.1), pytest.approx(0.1)]
+    # More iterations than the buffer holds: rows clamp, flag set.
+    pc = decode_phase_conv(0, 9, q, moved)
+    assert pc.truncated and pc.iterations == 9 and len(pc.rows) == 4
+    # Untracked moved counts (host color loops) poison the total.
+    pc = decode_phase_conv(0, 2, q)
+    assert pc.rows[0].moved == MOVED_UNTRACKED
+    assert pc.moved_total() is None
+    assert pc.summary()["moved_total"] is None
+
+
+def test_compile_watcher_nesting_restores_flag():
+    prior = bool(jax.config.jax_log_compiles)
+    events = []
+    with CompileWatcher(on_event=events.append) as outer:
+        assert bool(jax.config.jax_log_compiles) is True
+        with CompileWatcher():
+            pass
+        # The inner watcher restored the flag to the OUTER True state.
+        assert bool(jax.config.jax_log_compiles) is True
+        assert outer in logging.getLogger("jax").handlers
+    assert bool(jax.config.jax_log_compiles) is prior
+    assert outer not in logging.getLogger("jax").handlers
+
+
+def test_compile_watcher_nesting_outer_still_records():
+    """The OUTER watcher keeps receiving compile events during a nested
+    watcher's window — the inner one mutes jax's stream handler, never
+    another watcher (a muted outer guard would let a mid-measurement
+    recompile pass undetected)."""
+    @jax.jit
+    def nested_fresh(x):
+        return x - 12
+
+    with CompileWatcher() as outer:
+        with CompileWatcher() as inner:
+            nested_fresh(np.arange(23))  # unique shape: fresh compile
+        assert inner.compiles
+        assert outer.compiles, \
+            "outer watcher lost compiles inside the nested window"
+    assert len(outer.compiles) == len(inner.compiles)
+
+
+def test_flight_recorder_no_trace_skips_emitter():
+    """NO_TRACE: a recorder attached for its compile watcher / HBM
+    ledger only (the bench; --metrics-out without --trace-out) builds no
+    span records at all — and still collects compile events."""
+    from cuvite_tpu.obs import NO_TRACE
+
+    @jax.jit
+    def fresh_fn2(x):
+        return x * 5 - 3
+
+    with FlightRecorder(NO_TRACE) as rec:
+        assert rec.emitter is None and rec.sink is None
+        tr = Tracer(recorder=rec)
+        with tr.stage("iterate"):
+            fresh_fn2(np.arange(29))  # unique shape: fresh compile
+        tr.event("convergence", rows=[])  # facade no-ops, must not raise
+    assert rec.compile_events, "NO_TRACE must not disable the watcher"
+    assert tr.times.get("iterate", 0) > 0  # stage timing still works
+
+
+class _FakeLogRecord:
+    def __init__(self, msg):
+        self._msg = msg
+
+    def getMessage(self):
+        return self._msg
+
+
+def test_compile_watcher_prefix_names_pair_correctly():
+    """A module whose name prefixes another ('step' vs 'step2') must not
+    steal the other's completion: out-of-order completions pair with the
+    right pending compile and no phantom dur_s=None event remains."""
+    w = CompileWatcher()
+    w.emit(_FakeLogRecord("Compiling step with global shapes and types"))
+    w.emit(_FakeLogRecord("Compiling step2 with global shapes and types"))
+    w.emit(_FakeLogRecord("Finished XLA compilation of jit(step2) in 0.2 sec"))
+    w.emit(_FakeLogRecord("Finished XLA compilation of jit(step) in 0.1 sec"))
+    assert w._pending == []
+    assert [(e["module"], e["dur_s"]) for e in w.events] \
+        == [("jit(step2)", 0.2), ("jit(step)", 0.1)]
+
+
+def test_flight_recorder_records_compiles():
+    @jax.jit
+    def fresh_fn(x):
+        return x * 3 + 41
+
+    with FlightRecorder() as rec:
+        fresh_fn(np.arange(17))  # unique shape: guaranteed fresh compile
+    assert rec.compile_log, "watcher missed the fresh compile"
+    assert rec.compile_events and "module" in rec.compile_events[0]
+    names = [r.get("name") for r in rec.records if r.get("t") == "event"]
+    assert "compile" in names
+
+
+# ---------------------------------------------------------------------------
+# tools/perf_regress.py: the regression gate (tier-1 runs the self-check
+# so a malformed checked-in bench record can never land silently).
+
+
+def test_perf_regress_self_check_tier1():
+    out = subprocess.run(
+        [sys.executable, PERF_REGRESS, "--self-check"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "self-check ok" in out.stdout
+
+
+def _fresh_v4_record():
+    """The r05 trajectory record upgraded to a self-describing v4 fresh
+    record (what today's run_bench emits): perf_regress refuses to gate
+    anything less."""
+    with open(os.path.join(REPO, "BENCH_r05.json")) as f:
+        rec = json.load(f)["parsed"]
+    rec.update(
+        schema=4, engine="bucketed", vs_baseline=None,
+        graph=rec.get("graph", "rmat-18"),
+        modularity=rec.get("modularity", 0.5),
+        phases=rec.get("phases", 3),
+        compile_guard={"checked": True, "new_compiles": 0},
+        stages={"coarsen_s": 0.0, "upload_s": 0.0, "iterate_s": 0.0},
+        convergence_summary=[{"iterations": 1}],
+        compile_events=[], hbm_peak_by_buffer={})
+    return rec
+
+
+def test_perf_regress_passes_real_trajectory(tmp_path):
+    p = tmp_path / "fresh.json"
+    p.write_text(json.dumps(_fresh_v4_record()))
+    out = subprocess.run(
+        [sys.executable, PERF_REGRESS, "--record", str(p)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+
+
+def test_perf_regress_flags_30pct_teps_drop(tmp_path):
+    fresh = _fresh_v4_record()
+    fresh["value"] = round(fresh["value"] * 0.65, 1)  # 35% drop
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(fresh))
+    out = subprocess.run(
+        [sys.executable, PERF_REGRESS, "--record", str(p)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 1
+    assert "REGRESSION" in out.stderr and "TEPS" in out.stderr
+
+
+def test_perf_regress_refuses_schemaless_fresh_record(tmp_path):
+    """A fresh record with no int 'schema' must be refused (rc 2), not
+    gated leniently: run_bench always stamps schema=4, so a missing
+    field means record emission itself regressed."""
+    fresh = _fresh_v4_record()
+    del fresh["schema"]
+    p = tmp_path / "schemaless.json"
+    p.write_text(json.dumps(fresh))
+    out = subprocess.run(
+        [sys.executable, PERF_REGRESS, "--record", str(p)],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 2
+    assert "SCHEMA FAIL" in out.stderr and "schema" in out.stderr
+
+
+def test_perf_regress_stage_growth_and_floor():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from perf_regress import check_regression
+    finally:
+        sys.path.pop(0)
+    traj = [("BENCH_rX.json", 9, {
+        "metric": "louvain_teps_per_chip", "value": 100.0, "unit": "t/s",
+        "platform": "cpu", "scale": 18,
+        "stages": {"coarsen_s": 2.0, "upload_s": 0.01, "iterate_s": 10.0},
+    })]
+    fresh = {"metric": "louvain_teps_per_chip", "value": 98.0,
+             "unit": "t/s", "platform": "cpu", "scale": 18,
+             "stages": {"coarsen_s": 3.0, "upload_s": 0.4,
+                        "iterate_s": 10.0}}
+    probs = check_regression(fresh, traj, 0.30)
+    assert any("coarsen_s" in p for p in probs)       # 50% growth trips
+    assert not any("upload_s" in p for p in probs)    # sub-floor: noise
+    assert not any("TEPS" in p for p in probs)        # 2% drop is fine
+    # A different platform is a new baseline, not a regression.
+    assert check_regression(dict(fresh, platform="tpu"), traj, 0.30) == []
+    # A different input graph (both sides identified) is incomparable:
+    # a road network's intrinsic TEPS is not an rmat regression.
+    traj_g = [(p, n, dict(rec, graph="rmat-18")) for p, n, rec in traj]
+    slow_other = dict(fresh, graph="road-usa", value=10.0)
+    assert check_regression(slow_other, traj_g, 0.30) == []
+    # Same for engine: a bucketed run is not gated against a pallas
+    # ceiling (and a pallas regression is not hidden under bucketed's).
+    traj_e = [(p, n, dict(rec, engine="pallas")) for p, n, rec in traj]
+    slow_engine = dict(fresh, engine="bucketed", value=10.0)
+    assert check_regression(slow_engine, traj_e, 0.30) == []
+
+
+def test_perf_regress_self_check_catches_malformed(tmp_path):
+    good = {"n": 9, "cmd": "x", "rc": 0,
+            "parsed": {"metric": "louvain_teps_per_chip", "value": 1.0,
+                       "unit": "t/s"}}
+    (tmp_path / "BENCH_r09.json").write_text(json.dumps(good))
+    bad = dict(good, parsed={"metric": "louvain_teps_per_chip",
+                             "value": -3.0, "unit": "t/s"})
+    (tmp_path / "BENCH_r10.json").write_text(json.dumps(bad))
+    out = subprocess.run(
+        [sys.executable, PERF_REGRESS, "--self-check",
+         "--bench-glob", str(tmp_path / "BENCH_*.json")],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 1
+    assert "non-positive" in out.stderr

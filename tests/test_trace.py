@@ -1,5 +1,7 @@
 """Tracer / diagnostics tests."""
 
+import os
+
 import numpy as np
 
 from cuvite_tpu.louvain.driver import louvain_phases
@@ -85,6 +87,78 @@ def test_shard_diag_files(tmp_path, karate):
         assert len(lines) >= len(res.phases)
         assert lines[0].startswith("phase 0: owned=")
         assert "ghosts=" in lines[0] and "Q=" in lines[0]
+
+
+def test_shard_diag_lazy_file_creation(tmp_path):
+    """No file exists until the first write for that shard (a 64-shard
+    run that only diagnoses shard 3 creates ONE file)."""
+    from cuvite_tpu.utils.trace import ShardDiag
+
+    prefix = str(tmp_path / "sub" / "dat.out")
+    with ShardDiag(prefix, nshards=4) as diag:
+        assert not os.path.exists(os.path.dirname(prefix))
+        diag.write(2, "hello")
+        assert os.path.exists(f"{prefix}.2")
+        assert not os.path.exists(f"{prefix}.0")
+        assert not os.path.exists(f"{prefix}.1")
+
+
+def test_shard_diag_truncates_on_reopen(tmp_path):
+    """A rerun with the same prefix REPLACES each shard file (the
+    reference's per-rank ofstreams truncate too): stale lines from a
+    previous run never mix into a fresh diagnosis."""
+    from cuvite_tpu.utils.trace import ShardDiag
+
+    prefix = str(tmp_path / "dat.out")
+    with ShardDiag(prefix, nshards=2) as diag:
+        diag.write(0, "old run line 1")
+        diag.write(0, "old run line 2")
+        diag.write(1, "old shard-1 line")
+    with ShardDiag(prefix, nshards=2) as diag:
+        diag.write(0, "new run line")
+        # Shard 1 never written this run: its file keeps the OLD content
+        # (truncation is per-file on first write, not a prefix sweep).
+    assert open(f"{prefix}.0").read().splitlines() == ["new run line"]
+    assert open(f"{prefix}.1").read().splitlines() == ["old shard-1 line"]
+
+
+def test_tracer_stage_reentrancy():
+    """Nested stage() of the SAME name: the outer window CONTAINS the
+    inner one, so the accumulated time double-counts the inner span by
+    design (calls tells the reader how many windows there were), and a
+    recorder sees properly nested spans."""
+    import time
+
+    from cuvite_tpu.obs import FlightRecorder, spans_of, validate_trace
+
+    with FlightRecorder() as rec:
+        tr = Tracer(recorder=rec)
+        with tr.stage("iterate"):
+            with tr.stage("iterate"):
+                time.sleep(0.002)
+    assert tr.calls["iterate"] == 2
+    assert tr.times["iterate"] >= 2 * 0.002  # outer contains inner
+    assert validate_trace(rec.records) == []
+    spans = spans_of(rec.records, "iterate")
+    assert len(spans) == 2
+    outer = next(s for s in spans if s["begin"]["parent"] is None)
+    inner = next(s for s in spans if s is not outer)
+    assert inner["begin"]["parent"] == outer["id"]
+
+
+def test_breakdown_keeps_sub_millisecond_stages():
+    """ISSUE 6 satellite: breakdown() must NOT round — the historical
+    round(v, 3) reported a 0.4 ms upload as 0.0, making real-vs-absent
+    indistinguishable to the regression gate.  report() still rounds for
+    humans."""
+    tr = Tracer()
+    tr.times["upload"] = 4.2e-4
+    tr.times["iterate"] = 1.23456789
+    tr.calls = {"upload": 1, "iterate": 1}
+    bd = tr.breakdown()
+    assert bd["upload_s"] == 4.2e-4      # full precision survives
+    assert bd["iterate_s"] == 1.23456789
+    assert bd["coarsen_s"] == 0.0        # canonical stages always present
 
 
 def test_cli_dist_stats_flag(tmp_path, karate, capsys):

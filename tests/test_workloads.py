@@ -347,6 +347,14 @@ def test_bench_record_schema_and_guard_pass():
     for k in ("coarsen_s", "upload_s", "iterate_s"):
         assert k in rec["stages"] and rec["stages"][k] >= 0
     assert rec["stages"]["iterate_s"] > 0  # the phase loops always run
+    # Schema v4 (ISSUE 6): self-describing telemetry fields.
+    assert rec["schema"] == 4
+    assert rec["convergence_summary"], "recorded run must carry digests"
+    assert all(d["iterations"] >= 1 for d in rec["convergence_summary"])
+    # The warm-up compiles under the recorder: cold cost is on record.
+    assert rec["compile_events"], "cold run must record compile events"
+    assert all("module" in e for e in rec["compile_events"])
+    assert isinstance(rec["hbm_peak_by_buffer"], dict)
 
 
 def test_bench_aborts_on_injected_recompile():
@@ -390,7 +398,10 @@ def test_validate_record_rejects_unchecked_nonzero_compiles():
                                           "new_compiles": 2},
            "stages": {"coarsen_s": 0.0, "upload_s": 0.0,
                       "iterate_s": 1.0},
-           "engine": "bucketed"}
+           "engine": "bucketed", "schema": 4,
+           "convergence_summary": [{"phase": 0, "iterations": 3}],
+           "compile_events": [{"module": "jit(f)", "dur_s": 0.5}],
+           "hbm_peak_by_buffer": {"slab": 1024}}
     assert any("new_compiles" in p for p in validate_record(rec))
     # Schema v2: a record without the stage breakdown (or with a bogus
     # one) is rejected.
@@ -415,6 +426,15 @@ def test_validate_record_rejects_unchecked_nonzero_compiles():
     assert validate_record(pal_ok) == []
     pal_bad = dict(pal_ok, pallas_coverage=1.7)
     assert any("pallas_coverage" in p for p in validate_record(pal_bad))
+    # Schema v4: the telemetry fields are REQUIRED and type-checked; a
+    # pre-v4 record (no schema field) is rejected outright.
+    v3 = dict(ok)
+    del v3["schema"]
+    assert any("schema" in p for p in validate_record(v3))
+    for key, bad_val in (("convergence_summary", "nope"),
+                         ("compile_events", [{"dur_s": 1.0}]),
+                         ("hbm_peak_by_buffer", [1, 2])):
+        assert any(key in p for p in validate_record(dict(ok, **{key: bad_val}))), key
 
 
 # ---------------------------------------------------------------------------

@@ -11,7 +11,7 @@ Usage:
     python tools/heavy_ab.py                   # default backend (chip)
     CUVITE_PLATFORM=cpu python tools/heavy_ab.py   # interpret-mode smoke
 
-Appends a dated block to tools/heavy_ab_r5.log.
+Appends a dated block to tools/logs/heavy_ab_r5.log.
 """
 
 import os
@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG = os.path.join(REPO, "tools", "heavy_ab_r5.log")
+LOG = os.path.join(REPO, "tools", "logs", "heavy_ab_r5.log")
 
 
 def log(msg):

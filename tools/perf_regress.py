@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""Perf-regression gate over the BENCH_*.json trajectory (ISSUE 6).
+
+The repo's bench trajectory is the checked-in ``BENCH_r*.json`` round
+logs: ``{"n": <round>, "cmd": ..., "rc": ..., "tail": ..., "parsed":
+<bench record or null>}``.  Early rounds parsed minimal records; round 6+
+records are schema-v4 self-describing (``schema`` field, enforced by
+``cuvite_tpu.workloads.bench.validate_record``).  This tool turns that
+trajectory into a gate:
+
+    # compare a fresh bench record against the trajectory
+    python tools/perf_regress.py --record fresh.json [--threshold 0.30]
+
+    # structural self-check of every checked-in round log (tier-1,
+    # tests/test_obs.py): a malformed record can never land silently
+    python tools/perf_regress.py --self-check
+
+Comparison model: the fresh record is matched against trajectory records
+of the SAME platform (and scale, when both carry one).  The gate trips
+(exit 1) when the fresh TEPS falls more than ``--threshold`` below the
+trajectory best, or any canonical stage time (coarsen_s/upload_s/
+iterate_s) grows more than ``--threshold`` over the most recent
+comparable record that carries stages — wall-noise floors exempt stages
+under ``--stage-floor-s`` (default 0.5 s).  Exit codes: 0 ok, 1
+regression, 2 usage/parse error.
+
+Stdlib-only (no jax import): the tier-1 self-check must stay cheap, and
+a gate that needs a healthy accelerator to *parse JSON* would be useless
+exactly when a broken image is the thing being caught.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:  # validate_v4's lazy cuvite_tpu import
+    sys.path.insert(0, REPO_ROOT)
+
+TEPS_METRIC = "louvain_teps_per_chip"
+STAGE_KEYS = ("coarsen_s", "upload_s", "iterate_s")
+
+
+def load_trajectory(pattern: str) -> list:
+    """(path, round, record) for every round log whose ``parsed`` field
+    holds a bench record; raises ValueError on a structurally malformed
+    round log (the self-check's failure signal)."""
+    out = []
+    paths = sorted(glob.glob(pattern))
+    if not paths:
+        raise ValueError(f"no round logs match {pattern!r}")
+    for path in paths:
+        with open(path, encoding="utf-8") as f:
+            try:
+                log = json.load(f)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}: not valid JSON: {e}") from e
+        for key in ("n", "cmd", "rc"):
+            if key not in log:
+                raise ValueError(f"{path}: round log missing {key!r}")
+        rec = log.get("parsed")
+        if rec is None:
+            continue
+        if not isinstance(rec, dict):
+            raise ValueError(f"{path}: parsed must be a record or null")
+        for key in ("metric", "value", "unit"):
+            if key not in rec:
+                raise ValueError(f"{path}: parsed record missing {key!r}")
+        if rec["metric"] == TEPS_METRIC and not (
+                isinstance(rec["value"], (int, float)) and rec["value"] > 0):
+            raise ValueError(
+                f"{path}: non-positive TEPS value {rec['value']!r}")
+        out.append((path, log["n"], rec))
+    return out
+
+
+def validate_v4(path: str, rec: dict) -> list:
+    """Full schema validation for self-describing (v4+) records; pre-v4
+    trajectory records predate the schema field and get the structural
+    checks in load_trajectory only."""
+    if not isinstance(rec.get("schema"), int):
+        return []
+    from cuvite_tpu.workloads.bench import validate_record
+
+    return [f"{path}: {p}" for p in validate_record(rec)]
+
+
+def comparable(fresh: dict, rec: dict) -> bool:
+    if rec.get("platform") != fresh.get("platform"):
+        return False
+    if ("scale" in fresh and "scale" in rec
+            and fresh["scale"] != rec["scale"]):
+        return False
+    # Different input graphs / engines have different intrinsic TEPS —
+    # only gate like against like.  Pre-v4 trajectory rounds carry no
+    # 'graph' or 'engine' (all rmat, default engine), so each check
+    # engages only when both sides are identified.
+    if ("graph" in fresh and "graph" in rec
+            and fresh["graph"] != rec["graph"]):
+        return False
+    if ("engine" in fresh and "engine" in rec
+            and fresh["engine"] != rec["engine"]):
+        return False
+    return True
+
+
+def check_regression(fresh: dict, trajectory: list, threshold: float,
+                     stage_floor_s: float = 0.5) -> list:
+    """Regression strings (empty = gate passes) for a fresh record vs
+    the trajectory."""
+    problems = []
+    if fresh.get("metric") != TEPS_METRIC:
+        return [f"fresh record has metric {fresh.get('metric')!r}, "
+                f"expected {TEPS_METRIC!r}"]
+    peers = [(n, rec) for _, n, rec in trajectory
+             if rec.get("metric") == TEPS_METRIC and comparable(fresh, rec)]
+    if not peers:
+        # Nothing comparable (new platform/scale): first record of a new
+        # config is a baseline, not a regression.
+        return []
+    best_n, best = max(peers, key=lambda p: p[1]["value"])
+    floor = best["value"] * (1.0 - threshold)
+    if fresh["value"] < floor:
+        problems.append(
+            f"TEPS {fresh['value']:.3g} is "
+            f"{1.0 - fresh['value'] / best['value']:.0%} below the "
+            f"trajectory best {best['value']:.3g} (round {best_n}); "
+            f"gate allows {threshold:.0%}")
+    # Stage-level gate: against the most recent comparable record that
+    # carries stages (schema v2+ — early rounds predate the breakdown).
+    staged = [(n, rec) for n, rec in peers
+              if isinstance(rec.get("stages"), dict)]
+    if staged and isinstance(fresh.get("stages"), dict):
+        ref_n, ref = max(staged, key=lambda p: p[0])
+        for key in STAGE_KEYS:
+            old = ref["stages"].get(key)
+            new = fresh["stages"].get(key)
+            if not isinstance(old, (int, float)) \
+                    or not isinstance(new, (int, float)):
+                continue
+            if max(old, new) < stage_floor_s:
+                continue  # sub-floor stages are wall-clock noise
+            if old > 0 and new > old * (1.0 + threshold):
+                problems.append(
+                    f"stage {key} grew {new / old - 1.0:.0%} over round "
+                    f"{ref_n} ({old:.3g}s -> {new:.3g}s); gate allows "
+                    f"{threshold:.0%}")
+    return problems
+
+
+def self_check(pattern: str) -> list:
+    """Structural + (v4) schema problems across every checked-in round
+    log; also proves at least one parsed record exists."""
+    try:
+        trajectory = load_trajectory(pattern)
+    except ValueError as e:
+        return [str(e)]
+    problems = []
+    parsed = 0
+    for path, _, rec in trajectory:
+        parsed += 1
+        problems.extend(validate_v4(path, rec))
+    if not parsed:
+        problems.append(f"no round log under {pattern!r} carries a "
+                        "parsed bench record")
+    return problems
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python tools/perf_regress.py",
+        description="bench-trajectory perf-regression gate")
+    p.add_argument("--record", metavar="FILE.json",
+                   help="fresh bench record to gate (a bare record, or a "
+                        "round log with a 'parsed' field)")
+    p.add_argument("--threshold", type=float, default=0.30,
+                   help="allowed fractional drop in TEPS / growth in a "
+                        "stage time (default 0.30)")
+    p.add_argument("--stage-floor-s", type=float, default=0.5,
+                   help="ignore stages under this many seconds (wall "
+                        "noise; default 0.5)")
+    p.add_argument("--bench-glob",
+                   default=os.path.join(REPO_ROOT, "BENCH_*.json"),
+                   help="trajectory round logs (default: repo root)")
+    p.add_argument("--self-check", action="store_true",
+                   help="validate the checked-in trajectory itself "
+                        "(tier-1 gate) instead of comparing a record")
+    args = p.parse_args(argv)
+
+    if args.self_check:
+        problems = self_check(args.bench_glob)
+        if problems:
+            for prob in problems:
+                print(f"SELF-CHECK FAIL: {prob}", file=sys.stderr)
+            return 1
+        print("self-check ok: trajectory parses and validates")
+        return 0
+
+    if not args.record:
+        p.error("--record FILE.json or --self-check required")
+    try:
+        with open(args.record, encoding="utf-8") as f:
+            fresh = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"ERROR: cannot read {args.record}: {e}", file=sys.stderr)
+        return 2
+    if isinstance(fresh, dict) and isinstance(fresh.get("parsed"), dict):
+        fresh = fresh["parsed"]  # a round log wraps the record
+    if not isinstance(fresh, dict) \
+            or not isinstance(fresh.get("schema"), int):
+        # Pre-v4 leniency covers only the checked-in trajectory: a FRESH
+        # record comes from today's run_bench, which always stamps
+        # schema=4 — a missing field means record emission regressed,
+        # exactly what this gate must not wave through.
+        print(f"SCHEMA FAIL: {args.record}: fresh record carries no int "
+              "'schema' field (self-describing v4+ required; only "
+              "checked-in pre-v4 trajectory rounds are read leniently)",
+              file=sys.stderr)
+        return 2
+    problems = validate_v4(args.record, fresh)
+    if problems:
+        for prob in problems:
+            print(f"SCHEMA FAIL: {prob}", file=sys.stderr)
+        return 2
+    try:
+        trajectory = load_trajectory(args.bench_glob)
+    except ValueError as e:
+        print(f"ERROR: {e}", file=sys.stderr)
+        return 2
+    problems = check_regression(fresh, trajectory, args.threshold,
+                                args.stage_floor_s)
+    if problems:
+        for prob in problems:
+            print(f"REGRESSION: {prob}", file=sys.stderr)
+        return 1
+    peers = sum(1 for _, _, rec in trajectory if comparable(fresh, rec))
+    print(f"ok: no regression vs {peers} comparable trajectory "
+          f"record(s) at threshold {args.threshold:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

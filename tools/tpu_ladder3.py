@@ -21,7 +21,7 @@ valuable result first):
   then tools/heavy_ab.py (heavy-class kernel decision measurement).
 
 Success marker: tools/TPU_LADDER3_DONE (platform!=cpu bench JSON
-landed).  Every result appends to tools/tpu_ladder_r4.log immediately.
+landed).  Every result appends to tools/logs/tpu_ladder_r4.log immediately.
 """
 
 import json
@@ -32,7 +32,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
-LOG = os.path.join(REPO, "tools", "tpu_ladder_r4.log")
+LOG = os.path.join(REPO, "tools", "logs", "tpu_ladder_r4.log")
 DONE = os.path.join(REPO, "tools", "TPU_LADDER3_DONE")
 
 

@@ -6,7 +6,7 @@ coalesce at 131 GB.  This records the r4 `cv_build_csr_w32` path
 weighted R-MAT edge list: wall, coalesced edges, and RSS high-water.
 
 Usage: python tools/weighted_ingest_bench.py [scale] [edge_factor]
-Appends one line to tools/weighted_ingest.log.
+Appends one line to tools/logs/weighted_ingest.log.
 """
 
 import os
@@ -57,7 +57,7 @@ def main():
             f"nv={g.num_vertices} ne={g.num_edges} "
             f"wdtype={g.weights.dtype} total_hwm={hwm_mb()} MB")
     print(line)
-    with open(os.path.join(REPO, "tools", "weighted_ingest.log"), "a") as f:
+    with open(os.path.join(REPO, "tools", "logs", "weighted_ingest.log"), "a") as f:
         f.write(line + "\n")
 
 
