@@ -1,4 +1,4 @@
-"""graftlint rule set R001..R012 (see ANALYSIS.md for the catalogue).
+"""graftlint rule set R001..R013 (see ANALYSIS.md for the catalogue).
 
 Each rule targets a hazard class this codebase has actually hit (or is
 one refactor away from hitting): host syncs inside jitted code, jit
@@ -9,7 +9,9 @@ processes in tools, host-global side effects in test fixtures, network
 access outside the workloads fetch path (or without checksum
 verification), device->host pulls in phase-transition code, Pallas
 block shapes not derived from the static width-ladder constants, and
-bench timing windows that close without forcing device completion.
+bench timing windows that close without forcing device completion,
+and full-slab sorts in coarsen/kernels outside the sanctioned coalesce
+fallback chokepoint.
 
 Rules are heuristic by design: they trade completeness for a near-zero
 false-positive rate on idiomatic code, and every remaining intentional
@@ -918,3 +920,54 @@ class UnsyncedTimingWindow(Rule):
                     "without forcing completion (block_until_ready / a "
                     "readback): jax dispatch is async, so this records "
                     "launch latency, not execution time")
+
+
+# ---------------------------------------------------------------------------
+# R013: the coalesce sort tax must not creep back (ISSUE 8).
+# BASELINE.md round-7 measured the full-slab lax.sort as THE cost of
+# device-resident coarsening (coarsen_s 3.4 s -> 65.0 s at scale 20 on
+# CPU: above ~2^15 padded vertices the packed int32 key no longer fits
+# and lax.sort degrades to its slowest variadic comparator).  The ONLY
+# sanctioned full-slab sort for the coalesce is the fallback chokepoint
+# ops/segment.py::coalesced_runs (via sort_edges_by_vertex_comm), which
+# reports its engagement as bench coverage (`coalesce_kernel`).  A new
+# direct sort in coarsen/ or kernels/ would bypass both the dense
+# seg_coalesce engines and the coverage accounting — silently
+# re-imposing the tax.
+
+_SLAB_SORT_SCOPE = (
+    "cuvite_tpu/coarsen/",
+    "cuvite_tpu/kernels/",
+)
+_SLAB_SORT_CALLS = {
+    "jax.lax.sort", "lax.sort",
+    "jax.lax.sort_key_val", "lax.sort_key_val",
+    "jnp.sort", "jnp.argsort", "jnp.lexsort",
+    "jax.numpy.sort", "jax.numpy.argsort", "jax.numpy.lexsort",
+}
+
+
+@register
+class SlabSortOutsideChokepoint(Rule):
+    id = "R013"
+    severity = "high"
+    title = "full-slab device sort in coarsen/ or kernels/ outside the " \
+            "sanctioned coalesce fallback chokepoint"
+
+    def check(self, sf):
+        if not sf.rel.startswith(_SLAB_SORT_SCOPE):
+            return
+        for node in sf.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            if fname in _SLAB_SORT_CALLS:
+                yield self.finding(
+                    sf, node,
+                    f"{fname}() in a coarsen/kernel module: full-slab "
+                    "sorts are the round-7 coarsening tax and live ONLY "
+                    "behind ops/segment.coalesced_runs (the sanctioned "
+                    "fallback chokepoint, whose engagement is reported "
+                    "as bench coverage); route through it — or carry an "
+                    "inline '# graftlint: disable=R013' with a "
+                    "justification for a genuinely non-slab sort")

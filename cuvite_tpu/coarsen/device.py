@@ -19,15 +19,17 @@ with static pow2-padded shapes:
      (rebuild.cpp:167-197: smallest surviving label -> 0) and therefore
      ``rebuild.renumber_communities`` exactly;
   2. ``device_coarsen_slab`` — relabel both endpoints to dense ids and
-     coalesce duplicate (src, dst) pairs with the existing sort/segment
-     machinery (ops/segment.py), landing the coarse graph COMPACTED
-     into a prefix of the SAME slab class: out arrays keep the input's
-     [ne_pad] shape, real rows in [0, ne2), padding (src == nv_pad,
-     w == 0) after.  Phases whose coarse graph still fits the class
-     re-enter the same compiled step — zero retraces, zero transfers;
-     the driver drops to a smaller pow2 class only when the
-     one-scalar-per-phase host sync (already paid for convergence)
-     shows the graph fits, via ``shrink_slab``.
+     coalesce duplicate (src, dst) pairs through THE segmented-coalesce
+     chokepoint (ops/segment.py::coalesced_runs — packed sort by
+     default, the dense dst-tile engines of kernels/seg_coalesce.py on
+     request; graftlint R013 keeps stray slab sorts out), landing the
+     coarse graph COMPACTED into a prefix of the SAME slab class: out
+     arrays keep the input's [ne_pad] shape, real rows in [0, ne2),
+     padding (src == nv_pad, w == 0) after.  Phases whose coarse graph
+     still fits the class re-enter the same compiled step — zero
+     retraces, zero transfers; the driver drops to a smaller pow2 class
+     only when the one-scalar-per-phase host sync (already paid for
+     convergence) shows the graph fits, via ``shrink_slab``.
 
 Accumulation: duplicate-run weights sum in ``accum_dtype`` (default:
 the weight dtype; ``'ds32'`` = double-single pairs, collapsed to f32
@@ -49,7 +51,6 @@ import jax.numpy as jnp
 
 from cuvite_tpu.core.types import next_pow2
 from cuvite_tpu.ops import segment as seg
-from cuvite_tpu.ops.segment import DS_ACCUM
 
 
 def device_coarsen_enabled() -> bool:
@@ -79,9 +80,11 @@ def device_renumber(comm, real_mask, *, nv_pad: int):
     return dense_map, jnp.sum(present)
 
 
-@functools.partial(jax.jit, static_argnames=("nv_pad", "accum_dtype"))
+@functools.partial(jax.jit,
+                   static_argnames=("nv_pad", "accum_dtype", "coalesce"))
 def device_coarsen_slab(src, dst, w, comm, real_mask, *, nv_pad: int,
-                        accum_dtype=None, dense_map=None, nc=None):
+                        accum_dtype=None, dense_map=None, nc=None,
+                        coalesce=None):
     """Relabel + coalesce the resident edge slab into the next-phase slab.
 
     ``src``: [ne_pad] local vertex ids (pad == nv_pad, sorted to the
@@ -99,8 +102,17 @@ def device_coarsen_slab(src, dst, w, comm, real_mask, *, nv_pad: int,
     ``nc`` (pass both or neither): a precomputed :func:`device_renumber`
     of the SAME ``(comm, real_mask)`` — the fused driver reuses the one
     it already ran for label composition instead of renumbering twice.
+
+    ``coalesce`` (static): the segmented-coalesce engine — 'pallas' /
+    'xla' (the dense dst-tile bin-accumulate,
+    kernels/seg_coalesce.py; no sorted slab copy) or 'sort' (the packed
+    sort fallback).  None resolves via
+    ``seg_coalesce.coalesce_engine(nv_pad, accum_dtype)`` AT TRACE TIME
+    — callers that want env toggles honored per call (the drivers do)
+    must resolve and pass it explicitly.  Every engine produces the
+    same contract; weights are bit-identical across engines on the
+    exactness domain (see kernels/seg_coalesce.py).
     """
-    ne_pad = src.shape[0]
     wdt = w.dtype
     if dense_map is None:
         dense_map, nc = device_renumber(comm, real_mask, nv_pad=nv_pad)
@@ -115,43 +127,14 @@ def device_coarsen_slab(src, dst, w, comm, real_mask, *, nv_pad: int,
                         cdst.astype(dst.dtype))
     w_in = jnp.where(pad, jnp.zeros_like(w), w)
 
-    # Stable (src, dst) sort through the packed-key machinery: dense ids
-    # are < nc <= nv_pad, padding src == nv_pad sorts to the tail.
-    src_s, dst_s, w_s = seg.sort_edges_by_vertex_comm(
-        new_src, new_dst, w_in, src_bound=nv_pad + 1, key_bound=nv_pad)
+    if coalesce is None:
+        from cuvite_tpu.kernels.seg_coalesce import coalesce_engine
 
-    starts = seg.run_starts(src_s, dst_s)
-    run_id = jnp.cumsum(starts.astype(jnp.int32)) - 1
-    if accum_dtype == DS_ACCUM:
-        # Double-single run sums (ops/exactsum.py): exact integer mass up
-        # to ~2^48 — self-loop runs of benchmark-scale communities exceed
-        # f32's 2^24 long before they exceed this.  One f32 collapse at
-        # the end, like the host oracle's single f64 -> f32 cast.
-        from cuvite_tpu.ops import exactsum as ds
-
-        hi, lo, last = ds.ds_segment_sums_sorted(run_id, w_s)
-        run_w = (hi + lo).astype(wdt)
-    else:
-        acc = wdt if accum_dtype is None else accum_dtype
-        sums = seg.segment_sum(w_s.astype(acc), run_id,
-                               num_segments=ne_pad, sorted_ids=True)
-        run_w = jnp.take(sums, run_id).astype(wdt)
-        last = jnp.concatenate(
-            [(src_s[1:] != src_s[:-1]) | (dst_s[1:] != dst_s[:-1]),
-             jnp.ones((1,), bool)])
-
-    # Emit one row per run, at the run's LAST position (where the ds sum
-    # lives); runs are contiguous, so run order — and hence the compacted
-    # output order — is the sorted (src, dst) order either way.
-    emit = last & (src_s < nv_pad)
-    ne2 = jnp.sum(emit.astype(jnp.int32))
-    pos = jnp.cumsum(emit.astype(jnp.int32)) - 1
-    slot = jnp.where(emit, pos, ne_pad)  # non-emitted rows drop
-
-    src2 = jnp.full((ne_pad,), nv_pad, src.dtype).at[slot].set(
-        src_s, mode="drop")
-    dst2 = jnp.zeros((ne_pad,), dst.dtype).at[slot].set(dst_s, mode="drop")
-    w2 = jnp.zeros((ne_pad,), wdt).at[slot].set(run_w, mode="drop")
+        coalesce = coalesce_engine(nv_pad, accum_dtype)
+    src2, dst2, w2, ne2 = seg.coalesced_runs(
+        new_src, new_dst, w_in, nv_pad=nv_pad, accum_dtype=accum_dtype,
+        engine=coalesce)
+    w2 = w2.astype(wdt)
     return src2, dst2, w2, dense_map, nc, ne2
 
 

@@ -23,17 +23,28 @@ ascend in community id, so a strict `>` merge keeps the earlier (smaller)
 id on cross-tile ties, and the in-tile rule picks the smallest candidate
 among equal gains.
 
-Status per the design note's decision rule: built for interpret-mode
-correctness + the staged chip A/B (tools/heavy_ab.py); the XLA global
-sort path remains the default until the chip measurement says otherwise.
+Status (ISSUE 8): PROMOTED from interpret-only/default-off.  The
+single-shard bucketed/pallas engines route the heavy residual through
+this kernel by default on the TPU backend (``heavy_kernel_enabled``;
+CUVITE_HEAVY_KERNEL=0 is the kill switch, =1 forces interpret mode on
+other backends — how tier-1 pins the compiled-path parity on CPU), with
+the per-phase [D, H] row layout built by ``build_heavy_layout`` and the
+XLA sorted path kept as the degrade-with-coverage fallback when the
+layout exceeds its element budget (CUVITE_HEAVY_ELEMS), when the
+exchange is sparse (the kernel has no attached-size channel), or on a
+mesh (the layout is single-shard).  Eliminating the per-iteration heavy
+sort is the move-phase half of killing the sort tax; the coalesce half
+is kernels/seg_coalesce.py.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -42,6 +53,86 @@ DEFAULT_C_TILE = 512     # communities per tile ([Dc, C] one-hot block)
 DEFAULT_D_CHUNK = 1024   # neighbor slots reduced per fori step
 # [Dc, C] f32 one-hot + eq intermediates must sit well under v5e VMEM.
 assert DEFAULT_C_TILE * DEFAULT_D_CHUNK * 4 <= (4 << 20)
+
+# [D, Hp] layout element budget: the transposed heavy rows live in HBM
+# for the whole phase (two arrays, id + weight), so a hub set whose
+# padded matrix exceeds this stays on the sorted path instead of
+# doubling the slab's footprint.  2^24 slots = 64 MiB per f32 array.
+DEFAULT_MAX_LAYOUT_ELEMS = 1 << 24
+
+
+def heavy_kernel_enabled() -> bool:
+    """Default-on policy for the heavy (> 8192 neighbors) degree class
+    (ISSUE 8 promotion): the community-range-tile kernel replaces the
+    per-iteration heavy sort on the TPU backend.  CUVITE_HEAVY_KERNEL=0
+    retains the historical sorted path (the kill switch / A/B lever);
+    =1 forces the kernel in interpret mode on other backends — tier-1
+    runs the full driver this way to pin parity without a chip.  Read
+    per PhaseRunner construction, not at import."""
+    v = os.environ.get("CUVITE_HEAVY_KERNEL", "").strip().lower()
+    if v in ("0", "false", "off"):
+        return False
+    if v in ("1", "true", "on"):
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _layout_budget() -> int:
+    from cuvite_tpu.utils.envknob import env_int
+
+    return env_int("CUVITE_HEAVY_ELEMS", DEFAULT_MAX_LAYOUT_ELEMS)
+
+
+def build_heavy_layout(heavy_src, heavy_dst, heavy_w, *, nv_local: int,
+                       pad_id: int, d_chunk: int = DEFAULT_D_CHUNK,
+                       max_elems: int | None = None):
+    """Phase-static [D, Hp] transposed row layout of the heavy residual,
+    from the BucketPlan's padded (src, dst, w) triples.
+
+    Returns ``(verts [Hp], dstT [D, Hp], wT [D, Hp])`` — one hub per
+    column, columns in ascending vertex id, D a multiple of ``d_chunk``,
+    Hp a pow2 >= 8 (stable shapes: phases whose hub geometry pads to the
+    same (D, Hp) reuse the compiled step).  Padding slots carry dst ==
+    ``pad_id`` (the step masks them to a community >= nv_ceil, so they
+    are never candidates) and w == 0; padding columns carry verts ==
+    nv_local (dropped at assembly).  Returns None — the caller keeps the
+    sorted path, with a coverage warning — when there are no heavy
+    edges or the padded layout exceeds ``max_elems``
+    (CUVITE_HEAVY_ELEMS; the PALLAS_MAX_WIDTH degrade pattern).
+    """
+    if max_elems is None:
+        max_elems = _layout_budget()
+    hs = np.asarray(heavy_src)
+    real = hs < nv_local
+    s = hs[real].astype(np.int64)
+    if len(s) == 0:
+        return None
+    d = np.asarray(heavy_dst)[real]
+    w = np.asarray(heavy_w)[real]
+    if len(s) > 1 and np.any(s[:-1] > s[1:]):
+        # Plan triples arrive CSR-ordered; color-masked or synthetic
+        # inputs may not be.  Stable, so within-row edge order (the f32
+        # accumulation order contract) is preserved.
+        order = np.argsort(s, kind="stable")
+        s, d, w = s[order], d[order], w[order]
+    verts, counts = np.unique(s, return_counts=True)
+    H = len(verts)
+    Hp = max(1 << int(H - 1).bit_length() if H > 1 else 1, 8)
+    D = int(-(-int(counts.max()) // d_chunk)) * d_chunk
+    if D * Hp > max_elems:
+        return None
+    row_start = np.searchsorted(s, verts)
+    rows = np.arange(D, dtype=np.int64)
+    idx = row_start[None, :] + rows[:, None]        # [D, H]
+    has = rows[:, None] < counts[None, :]
+    idx = np.minimum(idx, len(d) - 1)
+    dstT = np.full((D, Hp), pad_id, dtype=np.asarray(heavy_dst).dtype)
+    wT = np.zeros((D, Hp), dtype=w.dtype)
+    dstT[:, :H] = np.where(has, d[idx], pad_id)
+    wT[:, :H] = np.where(has, w[idx], 0)
+    verts_out = np.full(Hp, nv_local, dtype=np.int64)
+    verts_out[:H] = verts
+    return verts_out, dstT, wT
 
 
 def _kernel(const_ref, cT_ref, wT_ref, ay_ref, curr_ref, vdeg_ref, sl_ref,

@@ -1,0 +1,281 @@
+"""Pallas TPU kernel: dst-community-tile binned segmented-coalesce for
+the inter-phase relabel+coalesce (the device-coarsening sort tax,
+ROADMAP open item 4 / ISSUE 8).
+
+Role.  ``coarsen/device.py::device_coarsen_slab`` must turn the
+relabeled edge slab (dense endpoint ids < nc, padding src == nv_pad)
+into one row per distinct (src, dst) pair, rows in ascending (src, dst)
+order compacted into the slab prefix, duplicate weights summed.  The
+historical workhorse is a full-slab packed sort + run detection
+(ops/segment.py) — and at benchmark scale the (src, dst) key needs
+2*log2(nv_pad) > 31 bits, so the int32 packing cannot engage and the
+sort degrades to XLA's slowest variadic comparator path: the measured
+65 s coarsen_s of BASELINE.md round-7.  GPU Louvain implementations do
+this aggregation step by BINNING, not sorting (Naim et al.,
+arXiv:1805.10904 bin neighbor weights by community; the shared-memory
+line treats aggregation as the dominant phase once moves are fast,
+Staudt & Meyerhenke, arXiv:1304.4453).
+
+This module is the TPU translation, same community-range-tile idea as
+``heavy_bincount``: the (src, dst) key domain is a dense [nv_pad,
+nv_pad] grid; tile the DST RANGE into [t*C, (t+1)*C) slices whose
+[nv_pad, C] accumulator fits VMEM, scan the slab once per tile, and
+bin-accumulate (weight sum + run presence count) — ascending flat index
+order over the accumulator IS the sorted (src, dst) run order, so the
+coalesced prefix is emitted directly with one cumsum + scatter and no
+sorted copy of the slab ever exists.
+
+Three engines, selected STATICALLY per slab class (``coalesce_engine``):
+
+* ``'pallas'`` — the tile kernel below (``seg_coalesce_pallas``).
+  Interpret-proven on CPU; the chip A/B is staged in tools/heavy_ab.py
+  + tpu_ladder3.py (the same built-then-chip-proven path
+  kernels/heavy_bincount.py and tools/heavy_kernel_design.md took).
+* ``'xla'`` — the bit-identical XLA twin (``seg_coalesce_xla``): the
+  same dense bin-accumulate as ONE O(ne) scatter-add over the flat key
+  domain.  Compiles on every backend; the cheap cross-engine parity
+  oracle, and the non-Pallas dense candidate for the chip A/B.
+* ``'sort'`` — the sanctioned packed-sort fallback chokepoint
+  (ops/segment.py::coalesced_runs), and the DEFAULT until the staged
+  chip A/B promotes a dense engine (see ``coalesce_engine`` for the
+  measured CPU rationale).  Slab classes whose key domain exceeds the
+  accumulator budget (nv_pad > SEG_COALESCE_MAX_NV), and every ds32
+  run-sum request (the pair arithmetic needs the sorted segmented
+  form), degrade here in every mode — with coverage reported in the
+  bench record (``coalesce_kernel``), mirroring the PALLAS_MAX_WIDTH
+  degrade-with-coverage pattern.
+
+Exactness.  The dense engines sum duplicate weights in SLAB order
+(scatter order), the sort path in sorted-run order; the two are
+bit-identical wherever run sums are exactly representable — unit and
+dyadic weights, the same documented exactness domain as the host-f64
+oracle contract in coarsen/device.py.  Run PRESENCE (the emitted row
+set, hence offsets/tails) is exact in every mode, including real
+zero-weight edges (counted by presence, never by weight).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+# dst communities per grid tile: the [nv_pad, C] f32+i32 accumulator pair
+# must sit well under v5e VMEM (~16 MB) at the widest eligible class —
+# the kernel shrinks C so nv_pad * C never exceeds this element budget
+# (8 MiB for the pair), whatever CUVITE_SEG_COALESCE_MAX_NV allows.
+ACC_BLOCK_ELEMS = 1 << 20
+DEFAULT_C_TILE = 256
+# edge slots scanned per inner grid step.
+DEFAULT_E_CHUNK = 8192
+assert (4096 * DEFAULT_C_TILE * 8) <= (12 << 20)
+assert 4096 * DEFAULT_C_TILE == ACC_BLOCK_ELEMS  # default class: no shrink
+
+# Widest slab class the dense accumulator covers: the flat key domain is
+# nv_pad^2 slots (f32 + i32), i.e. 128 MiB at the 4096 default — late
+# coarsened phases, where the reference's own cost model says binning
+# wins (tools/heavy_kernel_design.md).  Raising it quadruples the
+# accumulator per step.
+DEFAULT_MAX_NV = 4096
+
+
+def _env_max_nv() -> int:
+    from cuvite_tpu.utils.envknob import env_int
+
+    # 32768^2 flat keys is the int32 packing ceiling (2^30) and an
+    # 8 GiB accumulator — anything above is certainly a typo.
+    return env_int("CUVITE_SEG_COALESCE_MAX_NV", DEFAULT_MAX_NV,
+                   maximum=32768)
+
+
+def coalesce_engine(nv_pad: int, accum_dtype=None) -> str:
+    """THE static engine decision for one slab class: 'pallas', 'xla' or
+    'sort'.  Read per CALL by the drivers (not per trace — the result is
+    a static argument of device_coarsen_slab, so env toggles take effect
+    on the next phase without stale-trace hazards).
+
+    CUVITE_SEG_COALESCE: '' (default) — the packed-sort path; 'xla' /
+    'dense' / '1' — the XLA dense twin where the class fits; 'pallas' —
+    the tile kernel (interpret off-TPU); '0' / 'sort' — explicit sort
+    pin.  Ineligible classes (domain over budget, ds32) degrade to
+    'sort' in every mode, with coverage reported by the drivers
+    (the PALLAS_MAX_WIDTH degrade-with-coverage pattern).
+
+    Why default-off (measured, this rig, 24-core CPU backend): every
+    ELIGIBLE class (nv_pad <= 4096 -> 25-bit key) already rides the
+    packed int32 single-key sort, which beat the dense engines ~4.7x at
+    (nv_pad 4096, ne_pad 2^20) — XLA CPU scatters cost ~micro-seconds
+    per element.  The classes paying the real sort tax (nv_pad >= 2^16,
+    where kbits+sbits > 31 degrades lax.sort to the variadic comparator)
+    have a key domain no dense accumulator can hold.  So on CPU the sort
+    IS the best coalesce at every class; the dense engines are the
+    TPU-targeted bet (VMEM bin-accumulate vs on-chip sort), following
+    the heavy_bincount route: built, interpret-proven in tier-1, chip
+    A/B staged in tools/heavy_ab.py + tpu_ladder3.py, promoted when the
+    tunnel numbers say so.
+    """
+    mode = os.environ.get("CUVITE_SEG_COALESCE", "").strip().lower()
+    if mode in ("", "0", "false", "sort"):
+        return "sort"
+    if mode not in ("1", "true", "dense", "xla", "pallas"):
+        # A typo'd pin must never silently measure the wrong engine
+        # (the CUVITE_EXCHANGE_CUTOVER precedent): warn, keep the
+        # default.
+        import warnings
+
+        warnings.warn(
+            f"unrecognized CUVITE_SEG_COALESCE={mode!r} (want sort/0, "
+            "xla/dense/1, or pallas); using the default 'sort'",
+            stacklevel=2)
+        return "sort"
+    if accum_dtype is not None:
+        # Any explicit accumulator degrades to sort: ds32 needs the
+        # sorted segmented pair arithmetic (ops/exactsum), and a wider
+        # plain dtype would be silently narrowed by the dense
+        # accumulators (they sum in the weight dtype only).
+        return "sort"
+    if nv_pad > _env_max_nv():
+        return "sort"
+    if mode == "pallas":
+        return "pallas"
+    return "xla"
+
+
+def _kernel(src_ref, dst_ref, w_ref, acc_ref, cnt_ref, *, c_tile: int,
+            nv_pad: int):
+    t = pl.program_id(0)   # dst-community tile (outer, owns the block)
+    k = pl.program_id(1)   # slab chunk (inner, accumulates)
+
+    @pl.when(k == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        cnt_ref[:] = jnp.zeros_like(cnt_ref)
+
+    s = src_ref[:].reshape(-1)
+    d = dst_ref[:].reshape(-1)
+    w = w_ref[:].reshape(-1)
+    lo = t * c_tile
+    # Bin by dst tile: rows outside [lo, lo + C) — and padding rows,
+    # src == nv_pad — drop via the out-of-bounds scatter row.
+    in_tile = (s < nv_pad) & (d >= lo) & (d < lo + c_tile)
+    rows = jnp.where(in_tile, s, nv_pad)
+    cols = jnp.where(in_tile, d - lo, 0)
+    acc_ref[:] = acc_ref[:].at[rows, cols].add(
+        jnp.where(in_tile, w, jnp.zeros_like(w)), mode="drop")
+    cnt_ref[:] = cnt_ref[:].at[rows, cols].add(
+        in_tile.astype(jnp.int32), mode="drop")
+
+
+@functools.partial(
+    jax.jit, static_argnames=("nv_pad", "c_tile", "e_chunk", "interpret"))
+def seg_coalesce_pallas(src, dst, w, *, nv_pad: int,
+                        c_tile: int = DEFAULT_C_TILE,
+                        e_chunk: int = DEFAULT_E_CHUNK,
+                        interpret: bool = False):
+    """Dense (weight, count) accumulators of the relabeled slab, via the
+    dst-tile Pallas kernel.  src/dst: [ne_pad] int ids < nv_pad (padding
+    src == nv_pad, w == 0); returns (acc [nv_pad, nv_pad] of w.dtype,
+    cnt [nv_pad, nv_pad] int32) — feed :func:`emit_coalesced`."""
+    ne_pad = src.shape[0]
+    # VMEM guard: the [nv_pad, C] accumulator pair stays within
+    # ACC_BLOCK_ELEMS even when CUVITE_SEG_COALESCE_MAX_NV admits wider
+    # classes (pow2 operands keep every division exact).
+    c_tile = min(c_tile, nv_pad, max(ACC_BLOCK_ELEMS // nv_pad, 1))
+    e_chunk = min(e_chunk, ne_pad)
+    # Sub-lane slabs (tiny test classes) shrink the lane dim; pow2
+    # shapes keep every division exact.
+    lane = min(LANE, ne_pad)
+    assert nv_pad % c_tile == 0 and ne_pad % e_chunk == 0
+    grid = (nv_pad // c_tile, ne_pad // e_chunk)
+
+    rows = e_chunk // lane
+    slab_spec = pl.BlockSpec((rows, lane), lambda t, k: (k, 0),
+                             memory_space=pltpu.VMEM)
+    out_spec = pl.BlockSpec((nv_pad, c_tile), lambda t, k: (0, t),
+                            memory_space=pltpu.VMEM)
+    kernel = functools.partial(_kernel, c_tile=c_tile, nv_pad=nv_pad)
+    acc, cnt = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[slab_spec, slab_spec, slab_spec],
+        out_specs=(out_spec, out_spec),
+        out_shape=(
+            jax.ShapeDtypeStruct((nv_pad, nv_pad), w.dtype),
+            jax.ShapeDtypeStruct((nv_pad, nv_pad), jnp.int32),
+        ),
+        interpret=interpret,
+    )(
+        src.astype(jnp.int32).reshape(ne_pad // lane, lane),
+        dst.astype(jnp.int32).reshape(ne_pad // lane, lane),
+        w.reshape(ne_pad // lane, lane),
+    )
+    return acc, cnt
+
+
+def seg_coalesce_xla(src, dst, w, *, nv_pad: int):
+    """The kernel's bit-identical XLA twin: one O(ne) scatter-add over
+    the flat [nv_pad * nv_pad] key domain (the default dense engine —
+    compiles on every backend; on CPU this replaces the multi-second
+    comparator sort with a linear pass)."""
+    assert nv_pad & (nv_pad - 1) == 0, nv_pad  # flat packing needs pow2
+    kbits = (nv_pad - 1).bit_length()
+    real = src < nv_pad
+    flat = jnp.where(
+        real,
+        (src.astype(jnp.int32) << kbits) | dst.astype(jnp.int32),
+        jnp.int32(nv_pad * nv_pad),  # out of bounds -> dropped
+    )
+    acc = jnp.zeros((nv_pad * nv_pad,), dtype=w.dtype).at[flat].add(
+        jnp.where(real, w, jnp.zeros_like(w)), mode="drop")
+    cnt = jnp.zeros((nv_pad * nv_pad,), dtype=jnp.int32).at[flat].add(
+        real.astype(jnp.int32), mode="drop")
+    return acc.reshape(nv_pad, nv_pad), cnt.reshape(nv_pad, nv_pad)
+
+
+def emit_coalesced(acc, cnt, *, ne_pad: int, src_dtype, dst_dtype):
+    """Compact the dense accumulators into the coalesced slab prefix.
+
+    Ascending flat (src * nv_pad + dst) order IS the sorted (src, dst)
+    run order, so the emitted prefix is bit-identical (offsets, tails —
+    and weights on the exactness domain) to the packed-sort path's.
+    Returns (src2, dst2, w2, ne2) in the [ne_pad] class: real rows in
+    [0, ne2), padding (src == nv_pad, dst == 0, w == 0) after.
+    """
+    nv_pad = acc.shape[0]
+    assert nv_pad & (nv_pad - 1) == 0, nv_pad  # slab classes are pow2
+    kbits = (nv_pad - 1).bit_length()
+    flat_w = acc.reshape(-1)
+    present = cnt.reshape(-1) > 0
+    ne2 = jnp.sum(present.astype(jnp.int32))
+    pos = jnp.cumsum(present.astype(jnp.int32)) - 1
+    slot = jnp.where(present, pos, ne_pad)  # absent keys drop
+    idx = jnp.arange(nv_pad * nv_pad, dtype=jnp.int32)
+    src2 = jnp.full((ne_pad,), nv_pad, src_dtype).at[slot].set(
+        (idx >> kbits).astype(src_dtype), mode="drop")
+    dst2 = jnp.zeros((ne_pad,), dst_dtype).at[slot].set(
+        (idx & (nv_pad - 1)).astype(dst_dtype), mode="drop")
+    w2 = jnp.zeros((ne_pad,), flat_w.dtype).at[slot].set(flat_w,
+                                                         mode="drop")
+    return src2, dst2, w2, ne2
+
+
+def coalesce_slab(src, dst, w, *, nv_pad: int, engine: str,
+                  interpret: bool | None = None):
+    """One dense segmented-coalesce: accumulate + emit.  ``engine`` is
+    'pallas' or 'xla' (the 'sort' chokepoint lives in
+    ops/segment.coalesced_runs, which dispatches here).  ``interpret``
+    defaults to True off-TPU (the heavy_bincount convention)."""
+    if engine == "pallas":
+        if interpret is None:
+            interpret = jax.default_backend() != "tpu"
+        acc, cnt = seg_coalesce_pallas(src, dst, w, nv_pad=nv_pad,
+                                       interpret=interpret)
+    else:
+        acc, cnt = seg_coalesce_xla(src, dst, w, nv_pad=nv_pad)
+    return emit_coalesced(acc, cnt, ne_pad=src.shape[0],
+                          src_dtype=src.dtype, dst_dtype=dst.dtype)

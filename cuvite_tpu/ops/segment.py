@@ -154,6 +154,97 @@ def sort_edges_by_vertex_comm(src, ckey, w, *extras, src_bound=None,
     return jax.lax.sort((src, ckey, w) + extras, num_keys=2)
 
 
+def coalesced_runs(src, ckey, w, *, nv_pad, accum_dtype=None,
+                   engine="sort", interpret=None):
+    """Segmented coalesce of an edge slab by (src, ckey): one output row
+    per distinct real (src, ckey) pair, rows in ascending (src, ckey)
+    order COMPACTED into the slab prefix, duplicate weights summed.
+
+    The ``sort_edges_by_vertex_comm``-shaped entry point of ISSUE 8: same
+    (src, ckey, w) operand convention — real ids < ``nv_pad`` (pow2),
+    padding rows carry src == nv_pad and w == 0 — but the contract is the
+    COALESCED result, not a sorted copy, which frees the engine choice:
+
+    * ``engine='pallas'`` / ``'xla'`` — the dense dst-tile bin-accumulate
+      (cuvite_tpu/kernels/seg_coalesce.py): no sorted copy of the slab is
+      ever materialized.  Static eligibility (nv_pad within the
+      accumulator budget, no ds32) is the CALLER's job via
+      ``seg_coalesce.coalesce_engine`` — passing an ineligible class here
+      is a bug, not a fallback.
+    * ``engine='sort'`` — THE sanctioned packed-sort fallback chokepoint
+      (graftlint R013 allows no other full-slab sort in coarsen/ or
+      kernels/): stable sort via :func:`sort_edges_by_vertex_comm`
+      (src_bound = nv_pad + 1, key_bound = nv_pad), run detection, run
+      sums in ``accum_dtype`` (None = weight dtype; ``'ds32'`` =
+      double-single pairs collapsed to f32 once), emit at run-last
+      positions.  This is bit-for-bit the historical
+      device_coarsen_slab coalesce.
+
+    Returns ``(src_c, ckey_c, w_c, n)``: [ne_pad]-shaped arrays with real
+    rows in [0, n) and padding (src == nv_pad, ckey == 0, w == 0) after.
+    Dense engines sum duplicates in slab order, the sort engine in sorted
+    order — bit-identical wherever run sums are exactly representable
+    (unit/dyadic weights; the documented exactness domain, see
+    kernels/seg_coalesce.py).  ds32 must use the sort engine.
+    """
+    ne_pad = src.shape[0]
+    wdt = w.dtype
+    if engine in ("pallas", "xla"):
+        # The dense accumulators sum in the weight dtype only: a caller
+        # that requested ANY explicit accumulator (ds32 pairs or a wider
+        # plain dtype) must take the sort path — silently narrowing the
+        # requested accumulation would diverge from the sort engine
+        # outside the exactness domain.  coalesce_engine() enforces the
+        # same rule at policy level.
+        assert accum_dtype is None, \
+            f"accum_dtype={accum_dtype!r} needs the sort engine (dense " \
+            "engines accumulate in the weight dtype only)"
+        from cuvite_tpu.kernels.seg_coalesce import coalesce_slab
+
+        return coalesce_slab(src, ckey, w, nv_pad=nv_pad, engine=engine,
+                             interpret=interpret)
+
+    # Sanctioned sort fallback: stable (src, ckey) order through the
+    # packed-key machinery; dense ids are < nv_pad, padding src == nv_pad
+    # sorts to the tail.
+    src_s, ckey_s, w_s = sort_edges_by_vertex_comm(
+        src, ckey, w, src_bound=nv_pad + 1, key_bound=nv_pad)
+
+    starts = run_starts(src_s, ckey_s)
+    run_id = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    if accum_dtype == DS_ACCUM:
+        # Double-single run sums (ops/exactsum.py): exact integer mass up
+        # to ~2^48 — self-loop runs of benchmark-scale communities exceed
+        # f32's 2^24 long before they exceed this.  One f32 collapse at
+        # the end, like the host oracle's single f64 -> f32 cast.
+        from cuvite_tpu.ops import exactsum as ds
+
+        hi, lo, last = ds.ds_segment_sums_sorted(run_id, w_s)
+        run_w = (hi + lo).astype(wdt)
+    else:
+        acc = wdt if accum_dtype is None else accum_dtype
+        sums = segment_sum(w_s.astype(acc), run_id,
+                           num_segments=ne_pad, sorted_ids=True)
+        run_w = jnp.take(sums, run_id).astype(wdt)
+        last = jnp.concatenate(
+            [(src_s[1:] != src_s[:-1]) | (ckey_s[1:] != ckey_s[:-1]),
+             jnp.ones((1,), bool)])
+
+    # Emit one row per run, at the run's LAST position (where the ds sum
+    # lives); runs are contiguous, so run order — and hence the compacted
+    # output order — is the sorted (src, ckey) order either way.
+    emit = last & (src_s < nv_pad)
+    n = jnp.sum(emit.astype(jnp.int32))
+    pos = jnp.cumsum(emit.astype(jnp.int32)) - 1
+    slot = jnp.where(emit, pos, ne_pad)  # non-emitted rows drop
+    src_c = jnp.full((ne_pad,), nv_pad, src.dtype).at[slot].set(
+        src_s, mode="drop")
+    ckey_c = jnp.zeros((ne_pad,), ckey.dtype).at[slot].set(
+        ckey_s, mode="drop")
+    w_c = jnp.zeros((ne_pad,), wdt).at[slot].set(run_w, mode="drop")
+    return src_c, ckey_c, w_c, n
+
+
 def run_starts(src_s, ckey_s):
     """Boolean mask marking the first edge of every (src, comm) run in a
     sorted slab."""

@@ -1,0 +1,40 @@
+"""Shared integer env-knob parser for the kernel budget/eligibility
+knobs (CUVITE_SEG_COALESCE_MAX_NV, CUVITE_HEAVY_ELEMS, ...).
+
+One definition so the parse/warn/default behavior cannot drift between
+copies: accepts 0x/0b prefixes (``int(raw, 0)``), warns loudly on
+malformed or out-of-range values and falls back to the default — a
+typo'd knob must never silently measure the baseline while the
+operator believes it changed (the CUVITE_EXCHANGE_CUTOVER precedent).
+
+Note: ``louvain/bucketed.py::_env_int`` (the historical width-ladder
+knob parser) predates this helper with slightly different semantics
+(base-10 only, no range check) and keeps them for compatibility; new
+knobs should use this one.
+"""
+
+from __future__ import annotations
+
+import os
+import warnings
+
+
+def env_int(name: str, default: int, *, minimum: int = 1,
+            maximum: int | None = None) -> int:
+    """``int(os.environ[name], 0)`` clamped to [minimum, maximum], or
+    ``default`` (with a warning) when unset-empty, malformed, or out of
+    range."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        v = int(raw, 0)
+    except ValueError:
+        v = None
+    if v is None or v < minimum or (maximum is not None and v > maximum):
+        bound = (f" <= {maximum}" if maximum is not None else "")
+        warnings.warn(
+            f"malformed {name}={raw!r} (want an integer >= {minimum}"
+            f"{bound}); using the default {default}", stacklevel=2)
+        return default
+    return v

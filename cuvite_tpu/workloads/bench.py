@@ -50,11 +50,13 @@ REQUIRED_RECORD_KEYS = (
 # the edge mass actually ran through the kernel vs the XLA fallbacks.
 REQUIRED_PALLAS_KEYS = ("pallas_coverage", "pallas_width_hits")
 
-# Per-stage wall-clock fields every record must carry (schema v2, ISSUE 3):
+# Per-stage wall-clock fields every record must carry (schema v2, ISSUE 3;
+# coalesce_s since ISSUE 8 — the device relabel+coalesce slice nested
+# inside coarsen_s, i.e. the round-7 sort tax as its own gated number):
 # the breakdown that makes the device-resident coarsening win measurable
 # per phase instead of hiding inside one wall number.  Taken from the
 # tracer of the RECORDED run (utils.trace.Tracer.breakdown).
-REQUIRED_STAGE_KEYS = ("coarsen_s", "upload_s", "iterate_s")
+REQUIRED_STAGE_KEYS = ("coarsen_s", "coalesce_s", "upload_s", "iterate_s")
 
 
 class BenchCompileGuardError(RuntimeError):
@@ -131,6 +133,17 @@ def validate_record(rec: dict) -> list:
         if not isinstance(rec["hbm_peak_by_buffer"], dict):
             problems.append("hbm_peak_by_buffer must be a dict of "
                             "category -> peak nbytes")
+        ck = rec.get("coalesce_kernel")
+        if ck is not None and not (isinstance(ck, (int, float))
+                                   and 0.0 <= ck <= 1.0):
+            # Optional (device-coarsening runs only): the edge-weighted
+            # fraction of inter-phase coalesces that ran a dense
+            # seg_coalesce engine instead of the packed-sort fallback
+            # (ISSUE 8) — the honesty label tools/perf_regress.py needs
+            # next to a coalesce_s number.
+            problems.append(
+                f"coalesce_kernel must be a fraction in [0, 1], got "
+                f"{ck!r}")
     return problems
 
 
@@ -280,6 +293,15 @@ def run_bench(
         }
         if scale is not None:
             out["scale"] = scale
+        tr_counters = (tr.counters if tr is not None else {})
+        co_total = tr_counters.get("coalesce_edges", 0)
+        if co_total:
+            # Edge-weighted dense-engine coverage of the inter-phase
+            # coalesce (ISSUE 8): 0.0 = every coalesce took the
+            # packed-sort fallback (the honest default until the chip
+            # A/B promotes a dense engine).
+            out["coalesce_kernel"] = round(
+                tr_counters.get("coalesce_dense_edges", 0) / co_total, 4)
         if res.pallas_coverage is not None:
             # Kernel-coverage fields (schema v3): traversed-edge-weighted
             # fraction that ran the Pallas kernel + per-width hit counts,

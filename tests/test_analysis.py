@@ -380,6 +380,34 @@ def bench(x, opaque_fn):
 """,
         "tools/fake_r012.py",
     ),
+    (
+        "R013",
+        """
+import jax
+import jax.numpy as jnp
+
+def coalesce(src, dst, w):
+    # full-slab sort outside the sanctioned chokepoint: the round-7 tax
+    src_s, dst_s, w_s = jax.lax.sort((src, dst, w), num_keys=2)
+    order = jnp.argsort(src, stable=True)
+    return src_s, dst_s, w_s, order
+""",
+        """
+import jax
+import jax.numpy as jnp
+
+from cuvite_tpu.ops import segment as seg
+
+def coalesce(src, dst, w, nv_pad):
+    # routed through the sanctioned fallback chokepoint
+    return seg.coalesced_runs(src, dst, w, nv_pad=nv_pad, engine="sort")
+
+def tiny_row_sort(row):
+    # a genuinely non-slab sort, justified inline
+    return jax.lax.sort((row,), num_keys=1)  # graftlint: disable=R013 — O(D) per-row sort, not a slab
+""",
+        "cuvite_tpu/coarsen/fake_r013.py",
+    ),
 ]
 
 RULE_IDS = [c[0] for c in RULE_CASES]

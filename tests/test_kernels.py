@@ -246,3 +246,104 @@ def test_pallas_engine_rmat():
     res_b = louvain_phases(g, engine="bucketed")
     res_p = louvain_phases(g, engine="pallas")
     assert res_p.modularity == pytest.approx(res_b.modularity, abs=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 8: the heavy-class kernel promotion — layout builder, policy, and
+# the compiled-path (jitted driver, interpret kernel) parity pin.
+
+
+def test_build_heavy_layout_contract():
+    from cuvite_tpu.kernels.heavy_bincount import build_heavy_layout
+
+    nv_local, pad_id = 64, 4096
+    # CSR-ordered padded triples: vertex 3 (4 edges), vertex 7 (2 edges).
+    hs = np.array([3, 3, 3, 3, 7, 7, 64, 64], np.int64)
+    hd = np.array([10, 11, 12, 13, 20, 21, 0, 0], np.int64)
+    hw = np.array([1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 0, 0], np.float32)
+    verts, dT, wT = build_heavy_layout(hs, hd, hw, nv_local=nv_local,
+                                       pad_id=pad_id, d_chunk=8)
+    assert verts.shape == (8,) and dT.shape == wT.shape == (8, 8)
+    assert list(verts[:2]) == [3, 7] and (verts[2:] == nv_local).all()
+    assert list(dT[:4, 0]) == [10, 11, 12, 13]
+    assert list(wT[:2, 1]) == [5.0, 6.0]
+    # Padding slots: dst == pad_id (never a candidate), w == 0.
+    assert (dT[4:, 0] == pad_id).all() and (wT[2:, 1] == 0).all()
+    assert (dT[:, 2:] == pad_id).all()
+    # Element budget: an over-budget hub set degrades to None.
+    assert build_heavy_layout(hs, hd, hw, nv_local=nv_local,
+                              pad_id=pad_id, d_chunk=8,
+                              max_elems=16) is None
+    # No heavy edges at all -> None.
+    empty = np.full(8, nv_local, np.int64)
+    assert build_heavy_layout(empty, hd, hw, nv_local=nv_local,
+                              pad_id=pad_id) is None
+
+
+def test_heavy_kernel_policy(monkeypatch):
+    import jax
+
+    from cuvite_tpu.kernels.heavy_bincount import heavy_kernel_enabled
+
+    monkeypatch.delenv("CUVITE_HEAVY_KERNEL", raising=False)
+    # tier-1 runs on CPU: the default engages on the TPU backend only.
+    assert heavy_kernel_enabled() == (jax.default_backend() == "tpu")
+    monkeypatch.setenv("CUVITE_HEAVY_KERNEL", "0")   # kill switch
+    assert heavy_kernel_enabled() is False
+    monkeypatch.setenv("CUVITE_HEAVY_KERNEL", "1")   # forced (interpret)
+    assert heavy_kernel_enabled() is True
+
+
+@pytest.fixture(scope="module")
+def hub_graph():
+    """A graph with one genuinely heavy vertex (> 8192 neighbors, the
+    widths[-1] residual) plus background structure."""
+    from cuvite_tpu.core.graph import Graph
+
+    rng = np.random.default_rng(0)
+    nv = 9000
+    hub_dst = rng.choice(np.arange(1, nv), size=8400, replace=False)
+    src = np.concatenate([np.zeros(8400, np.int64),
+                          rng.integers(1, nv, 12000)])
+    dst = np.concatenate([hub_dst, rng.integers(1, nv, 12000)])
+    return Graph.from_edges(nv, src, dst)
+
+
+@pytest.mark.parametrize("engine", ["bucketed", "pallas"])
+def test_heavy_kernel_full_run_bit_identical(hub_graph, engine,
+                                             monkeypatch):
+    """The promoted heavy path (CUVITE_HEAVY_KERNEL=1 forces the kernel
+    in interpret mode on CPU — the same jitted driver path the TPU
+    default runs) must cluster bit-identically to the sorted heavy
+    path it replaces."""
+    from cuvite_tpu.louvain.driver import louvain_phases
+
+    monkeypatch.setenv("CUVITE_HEAVY_KERNEL", "0")
+    r0 = louvain_phases(hub_graph, engine=engine)
+    monkeypatch.setenv("CUVITE_HEAVY_KERNEL", "1")
+    r1 = louvain_phases(hub_graph, engine=engine)
+    assert len(r0.phases) == len(r1.phases) >= 2
+    assert r0.total_iterations == r1.total_iterations
+    assert r0.modularity == r1.modularity
+    assert np.array_equal(r0.communities, r1.communities)
+    if engine == "pallas":
+        # Coverage honesty: with the heavy kernel engaged the heavy
+        # residual (width 0) counts as kernelized; without it, not.
+        assert r1.pallas_coverage > r0.pallas_coverage
+        assert 0 in r1.pallas_width_hits
+
+
+def test_heavy_kernel_budget_degrade_keeps_sorted_path(hub_graph,
+                                                       monkeypatch):
+    """An over-budget hub layout must degrade loudly to the sorted path
+    and still produce the identical clustering (the PALLAS_MAX_WIDTH
+    degrade-with-coverage pattern)."""
+    from cuvite_tpu.louvain.driver import louvain_phases
+
+    monkeypatch.setenv("CUVITE_HEAVY_KERNEL", "0")
+    r0 = louvain_phases(hub_graph, engine="bucketed")
+    monkeypatch.setenv("CUVITE_HEAVY_KERNEL", "1")
+    monkeypatch.setenv("CUVITE_HEAVY_ELEMS", "64")
+    with pytest.warns(UserWarning, match="CUVITE_HEAVY_ELEMS"):
+        r1 = louvain_phases(hub_graph, engine="bucketed")
+    assert np.array_equal(r0.communities, r1.communities)

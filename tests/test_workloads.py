@@ -343,8 +343,9 @@ def test_bench_record_schema_and_guard_pass():
     assert rec["compile_guard"] == {"checked": True, "new_compiles": 0}
     assert rec["runs"] == 2 and len(rec["teps_runs"]) == 2
     assert rec["platform"] == "cpu" and rec["value"] > 0
-    # Schema v2: per-stage breakdown of the recorded run (ISSUE 3).
-    for k in ("coarsen_s", "upload_s", "iterate_s"):
+    # Schema v2: per-stage breakdown of the recorded run (ISSUE 3;
+    # coalesce_s — the device relabel+coalesce slice — since ISSUE 8).
+    for k in ("coarsen_s", "coalesce_s", "upload_s", "iterate_s"):
         assert k in rec["stages"] and rec["stages"][k] >= 0
     assert rec["stages"]["iterate_s"] > 0  # the phase loops always run
     # Schema v4 (ISSUE 6): self-describing telemetry fields.
@@ -396,8 +397,8 @@ def test_validate_record_rejects_unchecked_nonzero_compiles():
            "platform": "cpu", "graph": "x", "modularity": 0.1,
            "phases": 1, "compile_guard": {"checked": True,
                                           "new_compiles": 2},
-           "stages": {"coarsen_s": 0.0, "upload_s": 0.0,
-                      "iterate_s": 1.0},
+           "stages": {"coarsen_s": 0.0, "coalesce_s": 0.0,
+                      "upload_s": 0.0, "iterate_s": 1.0},
            "engine": "bucketed", "schema": 4,
            "convergence_summary": [{"phase": 0, "iterations": 3}],
            "compile_events": [{"module": "jit(f)", "dur_s": 0.5}],
@@ -412,6 +413,12 @@ def test_validate_record_rejects_unchecked_nonzero_compiles():
                stages={"coarsen_s": -1.0, "upload_s": 0.0,
                        "iterate_s": 1.0})
     assert any("coarsen_s" in p for p in validate_record(bad))
+    # ISSUE 8: coalesce_s is a required stage key; the optional
+    # coalesce_kernel coverage must be a fraction when present.
+    noco = dict(rec, compile_guard={"checked": True, "new_compiles": 0},
+                stages={"coarsen_s": 0.0, "upload_s": 0.0,
+                        "iterate_s": 1.0})
+    assert any("coalesce_s" in p for p in validate_record(noco))
     # Schema v3: an engine-less record is rejected, and a pallas record
     # must carry the kernel-coverage fields (honest TEPS labeling).
     ok = dict(rec, compile_guard={"checked": True, "new_compiles": 0})
@@ -426,6 +433,9 @@ def test_validate_record_rejects_unchecked_nonzero_compiles():
     assert validate_record(pal_ok) == []
     pal_bad = dict(pal_ok, pallas_coverage=1.7)
     assert any("pallas_coverage" in p for p in validate_record(pal_bad))
+    ck_bad = dict(ok, coalesce_kernel=2.0)
+    assert any("coalesce_kernel" in p for p in validate_record(ck_bad))
+    assert validate_record(dict(ok, coalesce_kernel=0.0)) == []
     # Schema v4: the telemetry fields are REQUIRED and type-checked; a
     # pre-v4 record (no schema field) is rejected outright.
     v3 = dict(ok)

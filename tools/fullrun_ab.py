@@ -32,14 +32,25 @@ def main():
     t0 = time.perf_counter()
     res = louvain_phases(g, engine=engine)
     print(f"# warmup wall {time.perf_counter() - t0:.1f}s", flush=True)
+    from cuvite_tpu.utils.trace import Tracer
+
+    tr = Tracer()  # stage breakdown incl. coalesce_s (ISSUE 8)
     t0 = time.perf_counter()
-    res = louvain_phases(g, engine=engine, verbose=False)
+    res = louvain_phases(g, engine=engine, verbose=False, tracer=tr)
     wall = time.perf_counter() - t0
     v, clus = teps(res)
     iters = sum(p.iterations for p in res.phases)
     print(f"Q={res.modularity:.5f} phases={len(res.phases)} iters={iters} "
           f"clustering={clus:.2f}s wall={wall:.1f}s "
           f"TEPS={v/1e6:.2f}M", flush=True)
+    bd = tr.breakdown()
+    stages = " ".join(f"{k}={bd[k]:.2f}" for k in sorted(bd))
+    co_tot = tr.counters.get("coalesce_edges", 0)
+    co_dense = tr.counters.get("coalesce_dense_edges", 0)
+    print(f"# stages: {stages}", flush=True)
+    if co_tot:
+        print(f"# coalesce_kernel={co_dense / co_tot:.4f} "
+              f"({co_dense:g}/{co_tot:g} edges dense)", flush=True)
     for p in res.phases:
         print(f"#   phase ne={p.num_edges} it={p.iterations} "
               f"t={p.seconds:.2f}s", flush=True)

@@ -1,17 +1,28 @@
-"""Heavy-class A/B: community-range-tile Pallas kernel vs the XLA sorted
-path, on hub rows (the decision measurement of heavy_kernel_design.md).
+"""Heavy-class + segmented-coalesce A/B: the two kernel-vs-sort decision
+measurements of ISSUE 8 (cf. heavy_kernel_design.md's decision rule).
 
-The kernel's cost is O(D * nv_ceil / C) matmul passes per row — linear in
-the COMMUNITY-SPACE size — while the sort path is O(D log^2 D) per row
-regardless of nv.  The sweep therefore times both over (D, nv_ceil) so
-the log records where (if anywhere) the tile kernel wins: the design
-note predicts only small nv_ceil (late coarsened phases) can favor it.
+Sweep 1 (heavy rows): community-range-tile Pallas kernel vs the XLA
+sorted path on hub rows.  The kernel's cost is O(D * nv_ceil / C)
+matmul passes per row — linear in the COMMUNITY-SPACE size — while the
+sort path is O(D log^2 D) per row regardless of nv.  The sweep times
+both over (D, nv_ceil) so the log records where the tile kernel wins.
+
+Sweep 2 (seg-coalesce, `python tools/heavy_ab.py seg`): the dense
+dst-tile coalesce engines (kernels/seg_coalesce.py, 'xla' twin +
+'pallas' kernel) vs the packed-sort chokepoint on relabeled-slab
+workloads across eligible slab classes — the measurement that decides
+whether CUVITE_SEG_COALESCE flips default-on per backend.  The round-7
+config itself (sort engine @ scale 20) is covered by
+tools/fullrun_ab.py with CUVITE_SEG_COALESCE set; this sweep isolates
+the coalesce op.  Appends to tools/logs/seg_coalesce_ab_r10.log.
 
 Usage:
-    python tools/heavy_ab.py                   # default backend (chip)
+    python tools/heavy_ab.py                   # both sweeps (chip)
+    python tools/heavy_ab.py heavy|seg         # one sweep
     CUVITE_PLATFORM=cpu python tools/heavy_ab.py   # interpret-mode smoke
 
-Appends a dated block to tools/logs/heavy_ab_r5.log.
+Appends dated blocks to tools/logs/heavy_ab_r5.log (heavy) and
+tools/logs/seg_coalesce_ab_r10.log (coalesce).
 """
 
 import os
@@ -27,13 +38,18 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "tools", "logs", "heavy_ab_r5.log")
+SEG_LOG = os.path.join(REPO, "tools", "logs", "seg_coalesce_ab_r10.log")
+
+
+def _log_to(path, msg):
+    line = f"[{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}] {msg}"
+    print(line, flush=True)
+    with open(path, "a") as f:
+        f.write(line + "\n")
 
 
 def log(msg):
-    line = f"[{time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime())}] {msg}"
-    print(line, flush=True)
-    with open(LOG, "a") as f:
-        f.write(line + "\n")
+    _log_to(LOG, msg)
 
 
 def time_best(fn, n=5):
@@ -44,6 +60,67 @@ def time_best(fn, n=5):
         fn()
         best = min(best, time.perf_counter() - t0)
     return best
+
+
+def seg_coalesce_ab():
+    """Sweep 2: dense coalesce engines vs the packed-sort chokepoint on
+    synthetic relabeled slabs (dense ids < nv_pad, 20% tail padding,
+    dyadic weights), per slab class.  Every cell also asserts the
+    engines' outputs are bit-identical before timing them."""
+    from cuvite_tpu.ops.segment import coalesced_runs
+
+    plat = jax.default_backend()
+    interpret = plat != "tpu"
+    _log_to(SEG_LOG, f"seg-coalesce A/B start backend={plat} "
+                     f"interpret={interpret}")
+    rng = np.random.default_rng(11)
+    for nv_pad, ne_pad in ((1024, 1 << 17), (4096, 1 << 18),
+                           (4096, 1 << 20)):
+        if interpret and ne_pad > (1 << 18):
+            # Interpret mode unrolls the kernel grid at trace time; the
+            # big slabs are chip cases.  The XLA twin still measures.
+            engines = ("sort", "xla")
+        else:
+            engines = ("sort", "xla", "pallas")
+        n_real = ne_pad - ne_pad // 5
+        src = np.full(ne_pad, nv_pad, np.int32)
+        dst = np.zeros(ne_pad, np.int32)
+        w = np.zeros(ne_pad, np.float32)
+        src[:n_real] = rng.integers(0, nv_pad, n_real)
+        dst[:n_real] = rng.integers(0, nv_pad, n_real)
+        w[:n_real] = rng.integers(1, 64, n_real) / 8.0
+        arrs = tuple(jnp.asarray(x) for x in (src, dst, w))
+
+        # One jitted callable per engine (engine/nv_pad static via the
+        # closure): every cell times a compiled program, none pays
+        # eager per-op dispatch — apples-to-apples.
+        def _jitted(eng):
+            return jax.jit(lambda s, d, ww: coalesced_runs(
+                s, d, ww, nv_pad=nv_pad, engine=eng))
+
+        # One jitted callable per engine, reused for the parity check
+        # AND the timing (a fresh jit wrapper would recompile sort for
+        # the reference and again for its timed cell).
+        runs = {eng: _jitted(eng) for eng in engines}
+        ref = jax.device_get(runs["sort"](*arrs))
+        times = {}
+        for eng in engines:
+            run = runs[eng]
+            got = jax.device_get(run(*arrs))
+            if not all(np.array_equal(r, g) for r, g in zip(ref, got)):
+                # A wrong-result engine must never contribute a timing
+                # the promotion decision could read: loud, and skipped.
+                _log_to(SEG_LOG,
+                        f"nv_pad={nv_pad} ne_pad={ne_pad}: {eng} "
+                        f"FAILED bit-identity vs sort — NOT timed")
+                continue
+            t = time_best(lambda r=run: jax.block_until_ready(r(*arrs)))
+            times[eng] = t
+            _log_to(SEG_LOG,
+                    f"nv_pad={nv_pad} ne_pad={ne_pad}: {eng} "
+                    f"{t * 1e3:.1f} ms  vs sort "
+                    f"{times[eng] / times['sort']:.2f}x")
+    _log_to(SEG_LOG, "seg-coalesce A/B done")
 
 
 def main():
@@ -128,4 +205,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    which = sys.argv[1] if len(sys.argv) > 1 else "both"
+    if which in ("heavy", "both"):
+        main()
+    if which in ("seg", "both"):
+        seg_coalesce_ab()

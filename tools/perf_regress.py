@@ -42,7 +42,10 @@ if REPO_ROOT not in sys.path:  # validate_v4's lazy cuvite_tpu import
     sys.path.insert(0, REPO_ROOT)
 
 TEPS_METRIC = "louvain_teps_per_chip"
-STAGE_KEYS = ("coarsen_s", "upload_s", "iterate_s")
+# coalesce_s (ISSUE 8) is the device relabel+coalesce slice nested
+# inside coarsen_s — gating it separately catches a sort-tax regression
+# that a constant-ish coarsen_s total would mask.
+STAGE_KEYS = ("coarsen_s", "coalesce_s", "upload_s", "iterate_s")
 
 
 def load_trajectory(pattern: str) -> list:

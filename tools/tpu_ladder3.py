@@ -286,12 +286,28 @@ def main():
         log(f"E: FAILED {type(e).__name__}: {e}")
     # Heavy-class decision measurement (heavy_kernel_design.md): tile
     # kernel vs XLA sorted path over (D, nv_ceil); its own dated log.
+    # `both` also runs the ISSUE 8 seg-coalesce sweep (dense dst-tile
+    # engines vs the packed-sort chokepoint, per slab class —
+    # tools/logs/seg_coalesce_ab_r10.log): the on-chip number that
+    # decides whether CUVITE_SEG_COALESCE flips default-on.
     try:
         subprocess.run([sys.executable,
-                        os.path.join(REPO, "tools", "heavy_ab.py")],
+                        os.path.join(REPO, "tools", "heavy_ab.py"),
+                        "both"],
                        timeout=1800)
     except subprocess.TimeoutExpired:
         log("heavy_ab: TIMEOUT (1800s)")
+    # Stage F (ISSUE 8): round-7 config end-to-end with the dense
+    # coalesce forced vs default — the fullrun side of the seg-coalesce
+    # A/B, on-chip.
+    try:
+        env = dict(os.environ, AB_SCALE="20", AB_ENGINE="sort",
+                   CUVITE_SEG_COALESCE="xla")
+        subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "fullrun_ab.py")],
+                       timeout=3600, env=env)
+    except subprocess.TimeoutExpired:
+        log("fullrun_ab (seg-coalesce stage F): TIMEOUT (3600s)")
     if got_tpu_json:
         with open(DONE, "w") as f:
             f.write(time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()) + "\n")
