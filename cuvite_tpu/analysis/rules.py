@@ -1,4 +1,4 @@
-"""graftlint rule set R001..R013 (see ANALYSIS.md for the catalogue).
+"""graftlint rule set R001..R014 (see ANALYSIS.md for the catalogue).
 
 Each rule targets a hazard class this codebase has actually hit (or is
 one refactor away from hitting): host syncs inside jitted code, jit
@@ -10,8 +10,9 @@ access outside the workloads fetch path (or without checksum
 verification), device->host pulls in phase-transition code, Pallas
 block shapes not derived from the static width-ladder constants, and
 bench timing windows that close without forcing device completion,
-and full-slab sorts in coarsen/kernels outside the sanctioned coalesce
-fallback chokepoint.
+full-slab sorts in coarsen/kernels outside the sanctioned coalesce
+fallback chokepoint, and compile/upload-per-job traps in serving queue
+loops.
 
 Rules are heuristic by design: they trade completeness for a near-zero
 false-positive rate on idiomatic code, and every remaining intentional
@@ -971,3 +972,58 @@ class SlabSortOutsideChokepoint(Rule):
                     "as bench coverage); route through it — or carry an "
                     "inline '# graftlint: disable=R013' with a "
                     "justification for a genuinely non-slab sort")
+
+
+# ---------------------------------------------------------------------------
+# R014: compile-per-job / upload-per-job traps in serving queue loops
+# (ISSUE 9).  The batched serving win rests on ONE compiled program per
+# (slab class, B) and ONE device placement per packed batch — both live
+# in louvain/batched.py at module scope.  A `jax.jit`/`jax.vmap` built
+# inside a serve/ queue loop creates a FRESH callable per iteration
+# (jit caches per callable identity, so every job recompiles), and a
+# per-job `jax.device_put` re-uploads what the batched driver would
+# place once per batch.  Either silently erases the amortization the
+# subsystem exists for, without changing any result — exactly the class
+# of regression a lint must catch, because no test output changes.
+
+_SERVE_SCOPE = ("cuvite_tpu/serve/",)
+_SERVE_LOOP_TRAPS = {
+    "jax.jit", "jax.vmap", "jax.pmap",
+    "jax.device_put", "jnp.asarray", "jax.numpy.asarray",
+}
+
+
+@register
+class ServeLoopCompileTrap(Rule):
+    id = "R014"
+    severity = "high"
+    title = "jit/vmap construction or per-job device upload inside a " \
+            "serve/ queue loop"
+
+    def check(self, sf):
+        if not sf.rel.startswith(_SERVE_SCOPE):
+            return
+        seen: set = set()
+        for loop in sf.walk():
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                fname = dotted(node.func)
+                if fname in _SERVE_LOOP_TRAPS:
+                    seen.add(id(node))
+                    what = ("recompiles per job (jit caches per "
+                            "callable identity)"
+                            if fname in _JIT_NAMES
+                            or fname in ("jax.vmap", "jax.pmap")
+                            else "re-uploads per job")
+                    yield self.finding(
+                        sf, node,
+                        f"{fname}() inside a serve/ queue loop {what}: "
+                        "the batched serving contract is ONE compiled "
+                        "program per (slab class, B) at module scope "
+                        "(louvain/batched.py) and ONE device placement "
+                        "per packed batch (run_batched); hoist it out "
+                        "of the loop, or justify with an inline "
+                        "'# graftlint: disable=R014'")

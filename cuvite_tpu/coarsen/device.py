@@ -152,6 +152,44 @@ def device_compose_labels(dense_map, labels, comm_all):
     return jnp.take(dense_map, jnp.take(labels, comm_all))
 
 
+# --- batched (multi-tenant) lifts, ISSUE 9 ---------------------------------
+# The batched driver (louvain/batched.py) runs B same-class graphs
+# through one compiled program with a leading batch axis; these are the
+# vmap lifts of the device coarsener it embeds.  They are plain
+# traceable functions (the inner jits inline under the caller's jit):
+# jitting here would fragment the driver's one-program-per-phase
+# property into per-helper dispatches.
+
+def batched_renumber(comm, real_mask, *, nv_pad: int):
+    """[B, nv_pad] lift of :func:`device_renumber`: per-row dense maps
+    and surviving-community counts ``(dense_map [B, nv_pad], nc [B])``."""
+    return jax.vmap(
+        functools.partial(device_renumber, nv_pad=nv_pad))(comm, real_mask)
+
+
+def batched_compose_labels(dense_map, labels, comm_all):
+    """[B, ...] lift of :func:`device_compose_labels`."""
+    return jax.vmap(device_compose_labels)(dense_map, labels, comm_all)
+
+
+def batched_coarsen_slab(src, dst, w, comm, real_mask, dense_map, nc, *,
+                         nv_pad: int, accum_dtype=None, coalesce="sort"):
+    """[B, ne_pad] lift of :func:`device_coarsen_slab` (precomputed
+    per-row ``dense_map``/``nc`` required — the batched driver always
+    has them from the label composition).  ``coalesce`` must be an
+    EXPLICIT engine and not ``'pallas'``: the Pallas grid does not lift
+    over a batch axis; the XLA twin and the packed sort both do."""
+    assert coalesce in ("sort", "xla"), \
+        f"batched coalesce engine {coalesce!r}: vmap lifts 'sort'/'xla' only"
+
+    def one(s, d, ww, c, rm, dm, n):
+        return device_coarsen_slab(
+            s, d, ww, c, rm, nv_pad=nv_pad, accum_dtype=accum_dtype,
+            dense_map=dm, nc=n, coalesce=coalesce)
+
+    return jax.vmap(one)(src, dst, w, comm, real_mask, dense_map, nc)
+
+
 def shrink_slab(src, dst, w, *, new_nv_pad: int, new_ne_pad: int):
     """Drop a compacted coarse slab to a smaller pow2 class — device ops
     only (a prefix slice plus a padding-sentinel rewrite; real ids are

@@ -1,0 +1,194 @@
+"""Batched multi-tenant slab packing (ISSUE 9).
+
+The serving traffic shape for "millions of users" is thousands of
+small-to-medium graphs arriving concurrently, not one giant graph.  The
+pow2 slab-class discipline (DistGraph.build's padded single-shard
+layout) already canonicalizes every graph into one of ~16 static
+``(nv_pad, ne_pad)`` shapes — which means B graphs of one class can be
+STACKED along a leading batch axis and pushed through ONE compiled
+Louvain program (louvain/batched.py), amortizing the compile and every
+kernel launch across tenants.  The same amortize-across-instances
+insight as the reference's bucketed per-degree-class kernels and
+PASCO's run-K-clusterings-in-parallel overlay (arXiv:2412.13592),
+applied at graph granularity.
+
+The batch size is itself padded to a small pow2 ladder (``BATCH_SIZES``)
+so ``(class, B_pad)`` is a static compiled shape too: a queue serving
+mixed batch sizes compiles at most ``len(BATCH_SIZES)`` programs per
+slab class, not one per arrival count.  Padding rows are all-padding
+slabs (every edge slot carries the ``src == nv_pad`` sentinel, zero
+weight, an all-false vertex mask and a zero gain constant) — they
+converge in two sweeps of the device loop and are dropped at unpack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from cuvite_tpu.core.distgraph import DistGraph
+from cuvite_tpu.core.types import next_pow2
+
+# Slab-class floors: MUST match the single-shard floors the per-graph
+# drivers use (DistGraph.build(min_nv_pad=4096, min_ne_pad=16384) in
+# driver._run_fused / coarsen.device.maybe_shrink_to_class), so a graph
+# lands in the same class whether it is served batched or alone.
+MIN_NV_PAD = 4096
+MIN_NE_PAD = 16384
+
+# The batch-size ladder: B pads to the smallest member >= n_jobs (counts
+# above the top rung pad to the next pow2).  Small and pow2 so a serving
+# queue's compile footprint stays bounded per slab class.
+BATCH_SIZES = (1, 2, 4, 8, 16, 32, 64)
+
+
+def slab_class_of(graph) -> tuple:
+    """The pow2 slab class ``(nv_pad, ne_pad)`` this graph canonicalizes
+    to under the single-shard floors — the serving queue's binning key.
+    Pure host arithmetic: no slab is built."""
+    return (
+        max(next_pow2(max(graph.num_vertices, 1)), MIN_NV_PAD),
+        max(next_pow2(max(graph.num_edges, 1)), MIN_NE_PAD),
+    )
+
+
+def batch_pad(n_jobs: int) -> int:
+    """Smallest BATCH_SIZES rung >= n_jobs (pow2 beyond the ladder)."""
+    if n_jobs < 1:
+        raise ValueError("need at least one job")
+    for b in BATCH_SIZES:
+        if n_jobs <= b:
+            return b
+    return next_pow2(n_jobs)
+
+
+@dataclasses.dataclass
+class BatchedSlab:
+    """B same-class single-shard slabs stacked on a leading batch axis.
+
+    Row layout per graph matches DistGraph.build's single-shard slab
+    (src ascending with padding ``src == nv_pad`` at the tail, dst pad
+    0, w pad 0); the single-shard padded id space IS the original id
+    space (old_to_pad identity), so per-tenant labels unpack by a plain
+    prefix slice.  Rows in ``[n_jobs, b_pad)`` are batch padding.
+    """
+
+    src: np.ndarray        # [b_pad, ne_pad] int32
+    dst: np.ndarray        # [b_pad, ne_pad] int32
+    w: np.ndarray          # [b_pad, ne_pad] weight dtype
+    real_mask: np.ndarray  # [b_pad, nv_pad] bool (all-false on pad rows)
+    constant: np.ndarray   # [b_pad] 1/(2m) per graph (0.0 on pad rows)
+    row_valid: np.ndarray  # [b_pad] bool
+    nv_real: np.ndarray    # [b_pad] int64 real vertex counts (0 on pad)
+    ne_real: np.ndarray    # [b_pad] int64 real directed edge counts
+    tw2: np.ndarray        # [b_pad] float64 total weight (2m) per graph
+    nv_pad: int
+    ne_pad: int
+    n_jobs: int
+
+    @property
+    def b_pad(self) -> int:
+        return int(self.src.shape[0])
+
+    @property
+    def slab_class(self) -> tuple:
+        return (self.nv_pad, self.ne_pad)
+
+    @property
+    def pack_util(self) -> float:
+        """Fraction of batch rows carrying a real job."""
+        return self.n_jobs / self.b_pad
+
+
+def batch_slabs(graphs, *, b_pad: int | None = None,
+                slab_class: tuple | None = None) -> BatchedSlab:
+    """Stack B same-class graphs into one :class:`BatchedSlab`.
+
+    Every graph must canonicalize to the SAME slab class (the queue in
+    cuvite_tpu/serve bins jobs by :func:`slab_class_of` before packing;
+    mixing classes here is a caller bug and raises) — unless
+    ``slab_class`` pins an explicit (pow2) ``(nv_pad, ne_pad)``: then
+    every graph pads UP into that class (any graph can occupy a larger
+    class; one too big for it raises).  The bench uses the pin so a job
+    set whose per-seed edge counts straddle a pow2 boundary still runs
+    one compiled program.  ``b_pad`` pads the batch axis (default:
+    :func:`batch_pad`); padding rows are all-padding slabs that cost
+    two masked device sweeps each.
+    """
+    if not graphs:
+        raise ValueError("batch_slabs: empty graph list")
+    classes = {slab_class_of(g) for g in graphs}
+    if slab_class is not None:
+        nv_pad, ne_pad = slab_class
+        too_big = [c for c in sorted(classes)
+                   if c[0] > nv_pad or c[1] > ne_pad]
+        if too_big:
+            raise ValueError(
+                f"batch_slabs: graphs of classes {too_big} do not fit "
+                f"the pinned slab class {tuple(slab_class)}")
+    elif len(classes) > 1:
+        raise ValueError(
+            f"batch_slabs: mixed slab classes {sorted(classes)} — bin "
+            "jobs by slab_class_of before packing (serve/queue.py "
+            "does), or pin a common class via slab_class=")
+    else:
+        nv_pad, ne_pad = classes.pop()
+    n = len(graphs)
+    bp = batch_pad(n) if b_pad is None else int(b_pad)
+    if bp < n:
+        raise ValueError(f"b_pad={bp} < {n} jobs")
+
+    # The batched program packs the TPU-default f32/int32 device dtypes.
+    # With x64 OFF that matches the per-graph drivers exactly (their
+    # _device_dtype clamps wide policies to 32-bit too, so served ==
+    # solo holds for bits64 files as well); with x64 ON a wide-policy
+    # graph WOULD keep f64 solo, so packing it here would silently
+    # change its results — refuse instead of diverging.
+    import jax
+
+    if jax.config.jax_enable_x64 and any(
+            np.dtype(g.policy.weight_dtype) == np.float64 for g in graphs):
+        raise ValueError(
+            "batch_slabs: wide-policy (f64-weight) graphs under "
+            "jax_enable_x64 keep f64 on the per-graph drivers; packing "
+            "them into the f32 batched slabs would silently change "
+            "their labels/Q — serve them through louvain_phases")
+    wdt = np.dtype(np.float32)
+    src = np.full((bp, ne_pad), nv_pad, dtype=np.int32)
+    dst = np.zeros((bp, ne_pad), dtype=np.int32)
+    w = np.zeros((bp, ne_pad), dtype=wdt)
+    real_mask = np.zeros((bp, nv_pad), dtype=bool)
+    constant = np.zeros(bp, dtype=wdt)
+    row_valid = np.zeros(bp, dtype=bool)
+    nv_real = np.zeros(bp, dtype=np.int64)
+    ne_real = np.zeros(bp, dtype=np.int64)
+    tw2 = np.zeros(bp, dtype=np.float64)
+
+    for i, g in enumerate(graphs):
+        # The class floors ARE the target shape: a pinned larger class
+        # raises the floors, and DistGraph.build pads up to them.
+        dg = DistGraph.build(g, 1, min_nv_pad=nv_pad,
+                             min_ne_pad=ne_pad)
+        assert (dg.nv_pad, dg.ne_pad) == (nv_pad, ne_pad)
+        sh = dg.shards[0]
+        src[i] = np.asarray(sh.src, dtype=np.int32)
+        dst[i] = np.asarray(sh.dst, dtype=np.int32)
+        w[i] = np.asarray(sh.w, dtype=wdt)
+        real_mask[i] = dg.vertex_mask()
+        t2 = g.total_edge_weight_twice()
+        if t2 <= 0:
+            raise ValueError(
+                f"batch_slabs: graph {i} has no edge weight (edgeless "
+                "graphs short-circuit in louvain_many, not here)")
+        constant[i] = wdt.type(1.0 / t2)
+        row_valid[i] = True
+        nv_real[i] = g.num_vertices
+        ne_real[i] = g.num_edges
+        tw2[i] = t2
+
+    return BatchedSlab(
+        src=src, dst=dst, w=w, real_mask=real_mask, constant=constant,
+        row_valid=row_valid, nv_real=nv_real, ne_real=ne_real, tw2=tw2,
+        nv_pad=nv_pad, ne_pad=ne_pad, n_jobs=n,
+    )

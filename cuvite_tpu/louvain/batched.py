@@ -1,0 +1,458 @@
+"""Batched multi-tenant Louvain: B same-class graphs, ONE compiled step
+per phase (ISSUE 9).
+
+Serving "millions of users" means thousands of small graphs (per-user
+neighborhoods, per-session interaction graphs) arriving concurrently —
+and for slab-class-canonicalized graphs the dominant cost of serving
+them one at a time is per-job dispatch: the compiled-program launch,
+the per-phase host sync, the Python driver overhead.  All of it is
+amortizable, because every graph of one ``(nv_pad, ne_pad)`` class runs
+the *same program on the same shapes*.  This driver stacks B such
+slabs on a leading batch axis (core/batch.py) and runs the whole batch
+through one jitted per-phase program:
+
+  * ``jax.vmap`` of the fused phase loop (louvain/fused.py::fused_phase):
+    under vmap the ``lax.while_loop`` iterates until EVERY row's phase
+    converges, masking finished rows — so B phase loops cost
+    max(iters_b) batched sweeps, not sum(iters_b) sequential ones;
+  * the vmapped device coarsener (coarsen/device.py::batched_renumber /
+    batched_compose_labels / batched_coarsen_slab): per-row dense
+    renumbering, label composition and slab relabel+coalesce, all in
+    HBM, landing every row's coarse graph back in the SAME class;
+  * per-graph phase exit by MASKING, not batch splitting: a row whose
+    phase fails the gain threshold keeps its composed labels and has
+    its slab overwritten with padding — trailing phases cost it two
+    masked sweeps, and the batch shape (the compile key) never changes.
+
+One host sync per phase for the whole batch (driver._phase_sync — the
+same chokepoint the per-graph drivers use, so the sync-spy tests cover
+both), one compile per ``(class, B)``, and one final O(B * nv_pad)
+label gather.  Labels and per-row Q are bit-identical to running the
+same driver at B=1 — vmap lifts every op row-wise, and nothing in the
+program mixes rows.
+
+Batch-axis data parallelism.  The program is row-independent by
+construction, so the batch axis shards over a 1-D device mesh with NO
+collectives (``shard_map`` with every spec ``P('b')``): on a TPU slice
+tenants spread across chips; on CPU the same split over
+``--xla_force_host_platform_device_count`` virtual devices is what
+makes batching pay — XLA:CPU executes a batched ``lax.sort`` serially
+(measured: a [64, 16384] two-channel sort costs exactly 64x the
+single-row sort on a 24-core host; sharded over 8 virtual devices it
+drops 7.3x), so without the mesh a CPU batch amortizes dispatch but
+serializes compute.  Each shard's ``while_loop`` trip count follows its
+OWN rows (no collectives inside), so a shard whose tenants converge
+early goes idle instead of pacing the batch.
+
+Scope: fixed threshold, no cycling (the cycling safety-net pass
+re-enters rows at different phases, which would fragment the batch; the
+serving default is the reference's final threshold 1e-6 anyway), plain
+schedule (no ET/coloring), single shard per row.  The per-graph drivers
+in driver.py keep every other configuration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cuvite_tpu.coarsen.device import (
+    batched_coarsen_slab,
+    batched_compose_labels,
+    batched_renumber,
+)
+from cuvite_tpu.core.batch import BatchedSlab, batch_slabs
+from cuvite_tpu.core.types import (
+    MAX_TOTAL_ITERATIONS,
+    TERMINATION_PHASE_COUNT,
+)
+from cuvite_tpu.louvain.fused import fused_phase
+from cuvite_tpu.obs.convergence import decode_phase_conv
+from cuvite_tpu.utils.upload import to_device
+
+
+def _phase_body(src, dst, w, comm_all, real_mask, prev_mod, active,
+                constant, threshold, *, nv_pad, accum_dtype, coalesce,
+                max_iters=MAX_TOTAL_ITERATIONS):
+    """One Louvain phase for the whole batch: vmapped fused phase loop +
+    gain test + vmapped device coarsening, converged rows masked.
+
+    Row state (all leading-axis B): ``src/dst/w`` — the current coarse
+    slab (dense ids, same class every phase); ``comm_all`` — original
+    vertex -> current dense community id; ``real_mask`` — current real-
+    vertex mask; ``prev_mod`` — last gaining phase's Q (or -1);
+    ``active`` — row still clustering.  Returns the updated state plus
+    per-row ``(gained, mod, iters, nc, ne2)`` scalars and the
+    convergence telemetry buffers ``(cq, cmoved, covf)`` [B, CAP].
+
+    Shape-polymorphic in the leading axis: jitted whole for the
+    single-device program, or wrapped per-shard by
+    :func:`_get_batched_phase` when the batch axis is sharded.
+    """
+    wdt = w.dtype
+    adt = accum_dtype
+
+    past, mod, iters, _ovf, (cq, cmoved, covf) = jax.vmap(
+        lambda s, d, ww, c: fused_phase(
+            s, d, ww, c, threshold, nv_pad=nv_pad, accum_dtype=adt,
+            max_iters=max_iters)
+    )(src, dst, w, constant)
+
+    mod = mod.astype(wdt)
+    gained = active & ((mod - prev_mod) > threshold)
+
+    # Vmapped device coarsener: dense renumber (reused by the label
+    # composition), relabel+coalesce back into the same slab class.
+    # Run sums accumulate in ds32 pairs exactly when the in-loop Q does
+    # (the same scale gate the per-graph drivers apply).
+    acc = "ds32" if accum_dtype == "ds32" else None
+    dmap, nc = batched_renumber(past, real_mask, nv_pad=nv_pad)
+    comm_all2 = batched_compose_labels(dmap, past, comm_all)
+    src2, dst2, w2, _dm, _nc, ne2 = batched_coarsen_slab(
+        src, dst, w, past, real_mask, dmap, nc,
+        nv_pad=nv_pad, accum_dtype=acc, coalesce=coalesce)
+    rm2 = jnp.arange(nv_pad, dtype=jnp.int32)[None, :] < nc[:, None]
+
+    # Masked phase exit: a gaining row advances to its coarse slab; a
+    # non-gaining (or already-inactive) row keeps its labels and has its
+    # slab retired to pure padding — trailing phases then cost it two
+    # masked sweeps, and the batch never splits or changes shape.
+    g2 = gained[:, None]
+    src_o = jnp.where(g2, src2, jnp.full_like(src, nv_pad))
+    dst_o = jnp.where(g2, dst2, jnp.zeros_like(dst))
+    w_o = jnp.where(g2, w2, jnp.zeros_like(w))
+    rm_o = jnp.where(g2, rm2, jnp.zeros_like(real_mask))
+    comm_all_o = jnp.where(g2, comm_all2, comm_all)
+    lower = jnp.asarray(-1.0, dtype=wdt)
+    prev_o = jnp.where(gained, jnp.maximum(mod, lower), prev_mod)
+
+    return (src_o, dst_o, w_o, comm_all_o, rm_o, prev_o,
+            gained, mod, iters, nc, ne2, cq, cmoved, covf)
+
+
+# The batch-axis mesh dimension name (tenant-parallel; orthogonal to the
+# vertex-sharding axis the SPMD engines use for ONE big graph).
+BATCH_AXIS = "b"
+
+# Compiled batched-phase programs, keyed by (mesh devices, statics) —
+# the "one compile per (class, B)" cache.  jax.jit already caches per
+# callable+shapes; this table keeps the CALLABLE identity stable across
+# batches so that cache engages (same pattern as driver._STEP_CACHE).
+_PHASE_CACHE: dict = {}
+
+
+def _get_batched_phase(mesh, nv_pad, accum_dtype, coalesce, max_iters):
+    key = (
+        None if mesh is None else tuple(d.id for d in mesh.devices.flat),
+        nv_pad, accum_dtype, coalesce, max_iters,
+    )
+    fn = _PHASE_CACHE.get(key)
+    if fn is not None:
+        return fn
+    body = functools.partial(
+        _phase_body, nv_pad=nv_pad, accum_dtype=accum_dtype,
+        coalesce=coalesce, max_iters=max_iters)
+    if mesh is None:
+        fn = jax.jit(body)
+    else:
+        from jax.sharding import PartitionSpec as P
+
+        from cuvite_tpu.comm.mesh import shard_map
+
+        b = P(BATCH_AXIS)
+        # Row-independent SPMD: every batched operand/output splits on
+        # the batch axis, the threshold scalar replicates, and the body
+        # contains NO collectives — each shard's while_loop paces only
+        # its own rows (check_vma off: nothing is replicated to check).
+        fn = jax.jit(shard_map(
+            body, mesh=mesh,
+            in_specs=(b, b, b, b, b, b, b, b, P()),
+            out_specs=(b,) * 14,
+            check_vma=False,
+        ))
+    _PHASE_CACHE[key] = fn
+    return fn
+
+
+def make_batch_mesh(b_pad: int, devices=None):
+    """A 1-D batch-axis mesh over the largest pow2 device count that
+    DIVIDES ``b_pad`` (shard_map needs the batch axis divisible by the
+    mesh; ladder-rung b_pads are pow2 so every pow2 <= them divides,
+    but an explicit caller b_pad may not be).  Returns None when one
+    device (or one row) makes sharding pointless — the caller then
+    runs the plain jitted program.
+    """
+    import numpy as _np
+
+    devs = list(jax.devices()) if devices is None else list(devices)
+    if b_pad <= 1 or len(devs) <= 1:
+        return None
+    from jax.sharding import Mesh
+
+    cap = 1 << (len(devs).bit_length() - 1)     # largest pow2 <= ndev
+    nd = min(b_pad & -b_pad, cap)               # largest pow2 | b_pad
+    if nd <= 1:
+        return None
+    return Mesh(_np.array(devs[:nd]), (BATCH_AXIS,))
+
+
+@dataclasses.dataclass
+class BatchResult:
+    """Per-tenant results plus the batch-level serving telemetry."""
+
+    results: list          # list[LouvainResult], one per REAL job, in order
+    wall_s: float          # whole-batch wall time (upload -> final gather)
+    n_phases: int          # batch phase count (max over rows)
+    b_pad: int
+    n_jobs: int
+    slab_class: tuple      # (nv_pad, ne_pad)
+
+    @property
+    def pack_util(self) -> float:
+        return self.n_jobs / max(self.b_pad, 1)
+
+    @property
+    def jobs_per_s(self) -> float:
+        return self.n_jobs / max(self.wall_s, 1e-9)
+
+
+def accum_class_of(graph, nv_pad: int | None = None) -> str:
+    """The in-loop accumulator tag this graph runs solo THROUGH THE
+    BATCHED DRIVER (``louvain_many([g])``; 'float32', or 'ds32' past
+    the DS_MIN_TOTAL_WEIGHT scale gate) — the second half of the
+    serving bin key.  Rows of one batch must share it: the accumulator
+    is a per-PROGRAM static, so a batch mixing a ds32-scale tenant with
+    f32 ones would run every row ds32 and silently break the
+    served-equals-solo bit-identity contract for the small rows.
+
+    The addend count floors at ``nv_pad`` (the padded reduction length
+    the batched program actually sums over) where the per-graph fused
+    driver floors at the REAL vertex count — deliberately one notch
+    more conservative: a graph whose padding alone crosses the gate
+    runs ds32 here, consistently at every B, while its
+    ``louvain_phases`` run may stay f32."""
+    from cuvite_tpu.core.batch import slab_class_of
+    from cuvite_tpu.louvain.driver import _accum_name
+
+    if nv_pad is None:
+        nv_pad = slab_class_of(graph)[0]
+    return _accum_name(np.float32, graph.total_edge_weight_twice(),
+                       max(graph.num_edges, nv_pad))
+
+
+def _batch_accum_name(batch: BatchedSlab) -> str:
+    """Static accumulator tag for the whole batch — rows must agree
+    (see :func:`accum_class_of`; the serving queue bins by it, so a
+    mixed batch here is a caller bug, not a degradable state)."""
+    from cuvite_tpu.louvain.driver import _accum_name
+
+    names = {
+        _accum_name(np.float32, float(batch.tw2[i]),
+                    max(int(batch.ne_real[i]), batch.nv_pad))
+        for i in range(batch.b_pad) if batch.row_valid[i]
+    }
+    if len(names) > 1:
+        raise ValueError(
+            f"mixed accumulator classes {sorted(names)} in one batch: "
+            "a per-program static accumulator would silently change "
+            "the f32 rows' results vs their solo runs — bin jobs by "
+            "(slab_class_of, accum_class_of) before packing "
+            "(serve/queue.py does)")
+    return names.pop() if names else "float32"
+
+
+def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
+                max_phases: int = TERMINATION_PHASE_COUNT,
+                mesh="auto", tracer=None, verbose: bool = False
+                ) -> BatchResult:
+    """Cluster every row of a packed batch; one compile per (class, B),
+    one host sync per phase, one final label gather.
+
+    Per-row semantics match the fused single-shard driver's plain
+    schedule at a fixed ``threshold``: phases run until a row's gain
+    drops below it (that row masks out), every row's reported Q is its
+    last gaining phase's in-loop value.  ``PhaseStats.seconds`` is the
+    batch phase wall split evenly over the rows active in that phase —
+    per-tenant wall is an AMORTIZED share, which is the serving-truth
+    number (the batch really did cost one wall interval).
+
+    ``mesh``: ``'auto'`` shards the batch axis over the largest usable
+    pow2 device count (:func:`make_batch_mesh`); ``None`` pins the
+    single-device program; or pass an explicit 1-D ``Mesh`` over
+    ``BATCH_AXIS``.  Sharding never changes per-row results — the
+    program has no cross-row op — only which device runs which rows.
+    """
+    from cuvite_tpu.kernels.seg_coalesce import coalesce_engine
+    from cuvite_tpu.louvain.driver import (
+        LouvainResult,
+        PhaseStats,
+        _phase_sync,
+    )
+
+    if tracer is None:
+        from cuvite_tpu.utils.trace import NullTracer
+
+        tracer = NullTracer()
+
+    t0 = time.perf_counter()
+    B = batch.b_pad
+    nv_pad = batch.nv_pad
+    wdt = np.dtype(np.float32)
+    adt = _batch_accum_name(batch)
+    # The Pallas seg-coalesce grid does not lift over vmap; when the env
+    # opts a dense engine in, the batched path runs its XLA twin
+    # (bit-identical on the exactness domain, kernels/seg_coalesce.py).
+    eng = coalesce_engine(nv_pad, "ds32" if adt == "ds32" else None)
+    if eng == "pallas":
+        eng = "xla"
+    if mesh == "auto":
+        mesh = make_batch_mesh(B)
+    phase_fn = _get_batched_phase(mesh, nv_pad, adt, eng,
+                                  MAX_TOTAL_ITERATIONS)
+
+    def _place(x):
+        if mesh is None:
+            return to_device(x)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(x, NamedSharding(mesh, P(BATCH_AXIS)))
+
+    with tracer.stage("upload"):
+        src_d = _place(batch.src)
+        dst_d = _place(batch.dst)
+        w_d = _place(batch.w)
+        rm_d = _place(batch.real_mask)
+        const_d = _place(batch.constant)
+        comm_all_d = _place(np.broadcast_to(
+            np.arange(nv_pad, dtype=np.int32)[None, :],
+            (B, nv_pad)).copy())
+        prev_d = _place(np.full((B,), -1.0, dtype=wdt))
+    tracer.ledger_phase_begin()
+    tracer.track("slab", src_d, dst_d, w_d)
+    tracer.track("tables", rm_d, const_d)
+
+    active = np.asarray(batch.row_valid).copy()
+
+    # Host-side per-row bookkeeping.
+    nv_cur = batch.nv_real.copy()
+    ne_cur = batch.ne_real.copy()
+    tot_iters = np.zeros(B, dtype=np.int64)
+    row_phases: list = [[] for _ in range(B)]
+    row_conv: list = [[] for _ in range(B)]
+    phase = 0
+
+    while active.any() and phase < max_phases:
+        t1 = time.perf_counter()
+        active_at_start = active.copy()
+        with tracer.stage("iterate"):
+            (src_d, dst_d, w_d, comm_all_d, rm_d, prev_d,
+             gained_d, mod_d, iters_d, nc_d, ne2_d,
+             cq_d, cmoved_d, covf_d) = phase_fn(
+                src_d, dst_d, w_d, comm_all_d, rm_d, prev_d,
+                active_at_start, const_d,
+                np.asarray(threshold, dtype=wdt),
+            )
+            # THE one device->host sync of this phase: every per-row
+            # scalar + the telemetry buffers in a single transfer.
+            gained, (mod_h, iters_h, nc_h, ne2_h, cq_h, cmoved_h,
+                     covf_h) = _phase_sync(
+                gained_d, mod_d, iters_d, nc_d, ne2_d,
+                cq_d, cmoved_d, covf_d)
+        gained = np.asarray(gained, dtype=bool)
+        phase_wall = time.perf_counter() - t1
+        n_active = max(int(active_at_start.sum()), 1)
+        share = phase_wall / n_active
+
+        traversed = 0
+        for i in np.flatnonzero(active_at_start):
+            it = int(iters_h[i])
+            tot_iters[i] += it
+            traversed += int(ne_cur[i]) * it
+            pc = decode_phase_conv(phase, it, cq_h[i], cmoved_h[i],
+                                   covf_h[i], gained=bool(gained[i]))
+            row_conv[i].append(pc)
+            if gained[i]:
+                row_phases[i].append(PhaseStats(
+                    phase=len(row_phases[i]),
+                    modularity=float(mod_h[i]), iterations=it,
+                    num_vertices=int(nv_cur[i]),
+                    num_edges=int(ne_cur[i]), seconds=share))
+                nv_cur[i] = int(nc_h[i])
+                ne_cur[i] = int(ne2_h[i])
+        tracer.count("traversed_edges", traversed)
+        active = active_at_start & gained \
+            & (tot_iters <= MAX_TOTAL_ITERATIONS)
+        if verbose:
+            print(f"batched phase {phase}: active {int(active.sum())}/"
+                  f"{batch.n_jobs}, iters {iters_h[:batch.n_jobs]}")
+        tracer.ledger_snapshot(phase)
+        phase += 1
+
+    # THE final label gather: one O(B * nv_pad) transfer for the whole
+    # batch; comm_all rows are already dense (composed through the
+    # per-phase device renumber).
+    comm_all_h, prev_h = jax.device_get((comm_all_d, prev_d))  # graftlint: disable=R010 — the allowlisted final label gather (batched)
+    wall = time.perf_counter() - t0
+
+    results = []
+    for i in range(batch.n_jobs):
+        nv = int(batch.nv_real[i])
+        results.append(LouvainResult(
+            communities=np.asarray(comm_all_h[i, :nv], dtype=np.int64),
+            modularity=float(prev_h[i]),
+            phases=row_phases[i],
+            total_iterations=int(tot_iters[i]),
+            total_seconds=sum(p.seconds for p in row_phases[i]),
+            convergence=row_conv[i],
+        ))
+    return BatchResult(
+        results=results, wall_s=wall, n_phases=phase, b_pad=B,
+        n_jobs=batch.n_jobs, slab_class=batch.slab_class,
+    )
+
+
+def cluster_many(graphs, *, threshold: float = 1.0e-6,
+                 max_phases: int = TERMINATION_PHASE_COUNT,
+                 b_pad: int | None = None, slab_class: tuple | None = None,
+                 mesh="auto", tracer=None,
+                 verbose: bool = False) -> BatchResult:
+    """Pack same-class graphs and run them as one batch (edgeless graphs
+    are answered inline — every vertex its own community, Q = 0 — and
+    never enter the packed batch, mirroring louvain_phases).  The
+    returned ``results`` list covers EVERY input in order;
+    ``n_jobs``/``pack_util``/``jobs_per_s`` describe only the PACKED
+    batch (inline-answered edgeless jobs cost no batch rows)."""
+    from cuvite_tpu.louvain.driver import LouvainResult
+
+    if tracer is None:
+        from cuvite_tpu.utils.trace import NullTracer
+
+        tracer = NullTracer()
+    edgeless = {i for i, g in enumerate(graphs) if g.num_edges == 0}
+    packed = [g for i, g in enumerate(graphs) if i not in edgeless]
+    if packed:
+        with tracer.stage("plan"):
+            batch = batch_slabs(packed, b_pad=b_pad,
+                                slab_class=slab_class)
+        br = run_batched(batch, threshold=threshold, max_phases=max_phases,
+                         mesh=mesh, tracer=tracer, verbose=verbose)
+    else:
+        br = BatchResult(results=[], wall_s=0.0, n_phases=0, b_pad=0,
+                         n_jobs=0, slab_class=(0, 0))
+    out = []
+    packed_iter = iter(br.results)
+    for i, g in enumerate(graphs):
+        if i in edgeless:
+            out.append(LouvainResult(
+                communities=np.arange(g.num_vertices, dtype=np.int64),
+                modularity=0.0, phases=[], total_iterations=0,
+                total_seconds=0.0))
+        else:
+            out.append(next(packed_iter))
+    br.results = out
+    return br

@@ -1533,6 +1533,38 @@ def _run_fused(graph, *, threshold, threshold_cycling, one_phase, balanced,
     )
 
 
+def louvain_many(
+    graphs,
+    threshold: float = 1.0e-6,
+    max_phases: int = TERMINATION_PHASE_COUNT,
+    b_pad: int | None = None,
+    slab_class: tuple | None = None,
+    mesh="auto",
+    tracer=None,
+    verbose: bool = False,
+):
+    """Cluster B same-slab-class graphs through ONE compiled per-phase
+    program (ISSUE 9): the multi-tenant analog of :func:`louvain_phases`.
+
+    Returns a ``louvain.batched.BatchResult`` whose ``results`` list
+    holds one :class:`LouvainResult` per input graph, in order, each
+    bit-identical to running this same entry with that graph alone
+    (B=1).  The batch axis pads to the ``core.batch.BATCH_SIZES``
+    ladder; per-graph phase exit is masking, not batch splitting, so
+    one compile serves every batch of the same ``(class, B)``.
+
+    Scope: fixed threshold / plain schedule / single shard per graph —
+    the serving configuration.  Heterogeneous classes are the SERVING
+    layer's job (cuvite_tpu/serve bins by class before packing); mixed
+    classes here raise.
+    """
+    from cuvite_tpu.louvain.batched import cluster_many
+
+    return cluster_many(graphs, threshold=threshold, max_phases=max_phases,
+                        b_pad=b_pad, slab_class=slab_class, mesh=mesh,
+                        tracer=tracer, verbose=verbose)
+
+
 def louvain_phases(
     graph: Graph,
     nshards: int = 1,

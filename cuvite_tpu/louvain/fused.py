@@ -72,6 +72,30 @@ def _phase_iterations(src, dst, w, vdeg, constant, threshold, lower, *,
     )
 
 
+def fused_phase(src, dst, w, constant, threshold, *, nv_pad, accum_dtype,
+                max_iters=MAX_TOTAL_ITERATIONS):
+    """ONE phase of the fused program as a plain traceable function: the
+    weighted-degree pass plus the on-device iteration loop, identity
+    start, convergence check inside.  This is the unit the batched
+    multi-tenant driver (louvain/batched.py, ISSUE 9) lifts over a
+    leading batch axis with ``jax.vmap`` — under vmap the while_loop
+    runs until EVERY row's phase converges, with finished rows' updates
+    masked, so B tenants' phases share one compiled loop and one
+    downstream host sync.  Returns ``(past, mod, iters, ovf,
+    (cq, cmoved, covf))`` exactly like ``_run_phase_loop``.
+
+    Deliberately NOT jitted here: callers embed it in their own jitted
+    programs (``fused_louvain`` below via ``_phase_iterations``; the
+    batched driver via ``vmap``)."""
+    vdeg = seg.segment_sum(w, src, num_segments=nv_pad, sorted_ids=True)
+    wdt = w.dtype
+    lower = jnp.asarray(-1.0, dtype=wdt)
+    return _phase_iterations(
+        src, dst, w, vdeg, constant, jnp.asarray(threshold, dtype=wdt),
+        lower, nv_pad=nv_pad, accum_dtype=accum_dtype, max_iters=max_iters,
+    )
+
+
 @functools.partial(
     jax.jit,
     static_argnames=("nv_pad", "max_phases", "accum_dtype", "cycling"),

@@ -1,0 +1,20 @@
+"""cuvite_tpu.serve — the multi-tenant serving layer (ISSUE 9).
+
+A slab-class serving queue in front of the batched driver
+(louvain/batched.py): incoming jobs bin by their pow2 slab class
+(core/batch.py::slab_class_of), pack into batches up to ``b_max`` with
+a max-linger deadline, run through ONE compiled per-phase program per
+``(class, B)``, and unpack into per-tenant ``LouvainResult``s.
+
+    python -m cuvite_tpu.serve demo --jobs 64 --b-max 16
+    python -m cuvite_tpu.serve cluster-many a.vite b.vite ...
+"""
+
+from cuvite_tpu.serve.queue import (
+    Job,
+    LouvainServer,
+    ServeConfig,
+    ServeStats,
+)
+
+__all__ = ["Job", "LouvainServer", "ServeConfig", "ServeStats"]

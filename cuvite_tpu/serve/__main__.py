@@ -1,0 +1,146 @@
+"""Serving CLI.
+
+    # synthetic multi-tenant load through the batching queue
+    python -m cuvite_tpu.serve demo --jobs 64 --edges 4096 --b-max 16
+
+    # cluster many Vite files as one multi-tenant workload
+    python -m cuvite_tpu.serve cluster-many a.vite b.vite --output
+
+Both paths run the slab-class batching queue (serve/queue.py) over the
+batched driver: jobs bin by class, pack to ``--b-max`` with a
+``--linger-ms`` deadline, and per-tenant results stream out as JSON
+lines, followed by one summary line (jobs/sec, pack_util, batches).
+
+On CPU the batch axis shards over virtual host devices
+(``--host-devices``, default 8): XLA:CPU executes a batched sort
+serially, so without the split a batch amortizes dispatch but
+serializes compute (louvain/batched.py has the measurement).  The flag
+must act before jax initializes — this module sets XLA_FLAGS first
+thing in ``main()``, so import jax only after argument parsing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m cuvite_tpu.serve",
+        description="slab-class batched Louvain serving")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(q):
+        q.add_argument("--b-max", type=int, default=64,
+                       help="max jobs per packed batch (BATCH_SIZES rung)")
+        q.add_argument("--linger-ms", type=float, default=50.0,
+                       help="max wait of the oldest job before a partial "
+                            "batch dispatches")
+        q.add_argument("--threshold", type=float, default=1e-6)
+        q.add_argument("--host-devices", type=int, default=8,
+                       help="virtual CPU devices to shard the batch axis "
+                            "over (ignored when jax already initialized "
+                            "or on a real accelerator); 1 disables")
+        q.add_argument("--trace-out", metavar="FILE.jsonl",
+                       help="flight-recorder span/event trace (pack spans, "
+                            "tenant_result events; OBSERVABILITY.md)")
+        q.add_argument("--json", action="store_true",
+                       help="per-tenant JSON result lines")
+
+    d = sub.add_parser("demo", help="synthetic multi-tenant load")
+    common(d)
+    d.add_argument("--jobs", type=int, default=32)
+    d.add_argument("--edges", type=int, default=4096,
+                   help="directed edge records per synthetic graph")
+    d.add_argument("--seed", type=int, default=1)
+
+    c = sub.add_parser("cluster-many",
+                       help="cluster many Vite files through the queue")
+    common(c)
+    c.add_argument("files", nargs="+", metavar="FILE.vite")
+    c.add_argument("--bits64", action="store_true")
+    c.add_argument("--output", action="store_true",
+                   help="write <file>.communities per input")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    from cuvite_tpu.utils.envknob import request_host_devices
+
+    request_host_devices(args.host_devices)
+
+    from cuvite_tpu.serve.queue import LouvainServer, ServeConfig
+    from cuvite_tpu.utils.compile_cache import enable_compile_cache
+    from cuvite_tpu.utils.trace import Tracer
+
+    enable_compile_cache()
+
+    import contextlib
+
+    rec_ctx = contextlib.nullcontext()
+    recorder = None
+    if args.trace_out:
+        from cuvite_tpu.obs import FlightRecorder, JsonlTraceSink
+
+        recorder = FlightRecorder(JsonlTraceSink(args.trace_out))
+        rec_ctx = recorder
+    tracer = Tracer(recorder=recorder)
+
+    server = LouvainServer(
+        ServeConfig(b_max=args.b_max, linger_s=args.linger_ms / 1e3,
+                    threshold=args.threshold),
+        tracer=tracer)
+
+    t0 = time.perf_counter()
+    with rec_ctx:
+        if args.cmd == "demo":
+            from cuvite_tpu.workloads.synth import many_seed, synthesize_graph
+
+            ids = {}
+            for k in range(args.jobs):
+                g = synthesize_graph(args.edges, seed=many_seed(args.seed, k))
+                ids[server.submit(g)] = f"synth-{k}"
+            finished = server.drain()
+        else:
+            from cuvite_tpu.io.vite import read_vite
+
+            ids = {}
+            for path in args.files:
+                g = read_vite(path, bits64=args.bits64)
+                ids[server.submit(g)] = path
+            finished = server.drain()
+            if args.output:
+                from cuvite_tpu.evaluate.compare import write_communities
+
+                by_id = dict(finished)
+                for jid, path in ids.items():
+                    if jid in by_id:  # failed jobs have no result
+                        write_communities(path + ".communities",
+                                          by_id[jid].communities)
+    wall = time.perf_counter() - t0
+
+    if args.json:
+        for jid, res in finished:
+            print(json.dumps({
+                "job": ids[jid], "job_id": jid,
+                "q": round(float(res.modularity), 6),
+                "communities": int(res.num_communities),
+                "phases": len(res.phases),
+                "iterations": int(res.total_iterations),
+            }))
+    summary = dict(server.stats.to_dict(), wall_s=round(wall, 3),
+                   wall_jobs_per_s=round(len(finished) / max(wall, 1e-9), 2))
+    if server.failures:
+        summary["failures"] = [
+            {"job": ids.get(jid, jid), "error": err}
+            for jid, err in server.failures]
+    print(json.dumps({"summary": summary}))
+    return 0 if not server.failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

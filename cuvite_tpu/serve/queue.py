@@ -1,0 +1,256 @@
+"""Slab-class batching queue: the serving layer over the batched driver.
+
+Queue discipline (ISSUE 9).  Jobs bin by (slab class, accumulator
+class) — the pow2 ``(nv_pad, ne_pad)`` shape their graph canonicalizes
+to plus its solo in-loop accumulator tag — because only same-class
+slabs can stack into one compiled program, and a batch mixing a
+ds32-scale tenant with f32 ones would silently change the f32 rows'
+results vs their solo runs (louvain/batched.py::accum_class_of).  A
+bin dispatches when either
+
+  * it holds ``b_max`` jobs (a full batch), or
+  * its OLDEST job has waited ``linger_s`` (the latency bound: a lone
+    tenant of a rare class must not wait for batch-mates that never
+    come).
+
+Dispatch packs up to ``b_max`` jobs, pads the batch axis to the
+``core.batch.BATCH_SIZES`` rung (so the compile cache sees a bounded
+set of ``(class, B)`` keys), runs ``louvain.batched.run_batched``, and
+unpacks per-tenant results in submission order.  Padding rows are the
+pack tax: ``pack_util`` (real rows / padded rows) is the serving
+metric that prices it, and it rides the bench record's ``batch`` block.
+
+This module deliberately contains NO jax calls: the compiled program
+lives at module scope in louvain/batched.py, device placement happens
+once per packed batch inside the driver.  graftlint R014 enforces the
+corresponding trap (jit/vmap construction or per-job device_put inside
+a serve/ queue loop — the compile-per-job and upload-per-job mistakes
+that would silently erase the batching win).
+
+Observability: every dispatch opens a ``pack`` span (class, jobs, B,
+linger-triggered or full) and emits one ``tenant_result`` event per
+job; OBSERVABILITY.md documents the fields.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import time
+
+from cuvite_tpu.core.batch import BATCH_SIZES, batch_pad, slab_class_of
+from cuvite_tpu.core.types import TERMINATION_PHASE_COUNT
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Queue knobs.  ``b_max`` should be a BATCH_SIZES rung (it is
+    clamped to one): it caps both batch latency amortization and the
+    compile-cache footprint per class.  ``linger_s`` bounds the extra
+    latency batching may add to any single job."""
+
+    b_max: int = 64
+    linger_s: float = 0.05
+    threshold: float = 1.0e-6
+    max_phases: int = TERMINATION_PHASE_COUNT
+    mesh: object = "auto"   # forwarded to run_batched
+
+    def __post_init__(self) -> None:
+        if self.b_max < 1:
+            raise ValueError("b_max must be >= 1")
+        # Round up to a ladder rung (full bins then pack with zero
+        # padding), capped at the ladder top.
+        self.b_max = min(batch_pad(self.b_max), BATCH_SIZES[-1])
+
+
+@dataclasses.dataclass
+class Job:
+    job_id: str
+    graph: object
+    slab_class: tuple
+    t_submit: float
+
+
+@dataclasses.dataclass
+class ServeStats:
+    """Aggregate serving counters (monotone; read any time)."""
+
+    jobs_submitted: int = 0
+    jobs_done: int = 0
+    jobs_failed: int = 0
+    batches: int = 0
+    rows_real: int = 0
+    rows_padded: int = 0     # total batch rows incl. padding
+    linger_dispatches: int = 0
+    busy_s: float = 0.0      # wall spent inside the batched driver
+
+    @property
+    def pack_util(self) -> float:
+        return self.rows_real / max(self.rows_padded, 1)
+
+    @property
+    def jobs_per_s(self) -> float:
+        return self.jobs_done / max(self.busy_s, 1e-9)
+
+    def to_dict(self) -> dict:
+        return {
+            "jobs_submitted": self.jobs_submitted,
+            "jobs_done": self.jobs_done,
+            "jobs_failed": self.jobs_failed,
+            "batches": self.batches,
+            "pack_util": round(self.pack_util, 4),
+            "linger_dispatches": self.linger_dispatches,
+            "busy_s": round(self.busy_s, 4),
+            "jobs_per_s": round(self.jobs_per_s, 2),
+        }
+
+
+class LouvainServer:
+    """Synchronous serving core: ``submit()`` enqueues, ``step()`` runs
+    every due batch and returns finished ``(job_id, LouvainResult)``
+    pairs.  A daemon wraps this in its arrival loop (serve/__main__.py);
+    keeping the core synchronous keeps results deterministic and
+    testable — the queue decides WHAT runs together, the batched driver
+    decides how.
+
+    ``clock`` is injectable (tests drive linger deadlines without
+    sleeping).
+    """
+
+    def __init__(self, config: ServeConfig | None = None, tracer=None,
+                 clock=time.monotonic):
+        self.config = config or ServeConfig()
+        if tracer is None:
+            from cuvite_tpu.utils.trace import NullTracer
+
+            tracer = NullTracer()
+        self.tracer = tracer
+        self.clock = clock
+        self.stats = ServeStats()
+        # Jobs whose clustering raised: (job_id, error string).  They
+        # are reported here instead of poisoning their batch — see
+        # _dispatch's isolation retry.
+        self.failures: list = []
+        self._bins: dict = collections.defaultdict(collections.deque)
+        self._ids = itertools.count()
+
+    # -- intake -------------------------------------------------------------
+
+    def submit(self, graph, job_id: str | None = None) -> str:
+        """Enqueue one clustering job; returns its id.  Binning is by
+        (slab class, accumulator class) — pure host arithmetic, no slab
+        is built here."""
+        from cuvite_tpu.louvain.batched import accum_class_of
+
+        if job_id is None:
+            job_id = f"job-{next(self._ids)}"
+        cls = slab_class_of(graph)
+        self._bins[(cls, accum_class_of(graph, cls[0]))].append(
+            Job(job_id=job_id, graph=graph, slab_class=cls,
+                t_submit=self.clock()))
+        self.stats.jobs_submitted += 1
+        return job_id
+
+    def pending(self) -> int:
+        return sum(len(q) for q in self._bins.values())
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _due(self, now: float, force: bool) -> list:
+        """Classes with a dispatchable batch: full bins always; partial
+        bins once their oldest job lingered past the deadline (or on
+        ``force``, the drain path)."""
+        due = []
+        for cls, q in self._bins.items():
+            if not q:
+                continue
+            if force or len(q) >= self.config.b_max \
+                    or (now - q[0].t_submit) >= self.config.linger_s:
+                due.append(cls)
+        return due
+
+    def _dispatch(self, jobs, cls, trigger, now) -> list:
+        """Run one packed batch and unpack per-tenant results.  A batch
+        whose clustering RAISES must not take its batchmates down: the
+        batch splits and each job retries alone; a job that fails alone
+        lands in ``self.failures`` (never back in the queue — a poison
+        job re-queued would raise forever)."""
+        from cuvite_tpu.louvain.batched import cluster_many
+
+        # Edgeless jobs are answered inline by cluster_many and occupy
+        # no batch row: the padded shape and the pack accounting follow
+        # the rows that actually hit the device.
+        n_real = sum(1 for j in jobs if j.graph.num_edges > 0)
+        b_pad = batch_pad(n_real) if n_real else 0
+        sid = self.tracer.begin_span(
+            "pack", slab_class=list(cls), jobs=len(jobs), b_pad=b_pad,
+            trigger=trigger)
+        t0 = time.perf_counter()
+        try:
+            br = cluster_many(
+                [j.graph for j in jobs],
+                threshold=self.config.threshold,
+                max_phases=self.config.max_phases,
+                b_pad=b_pad or None, mesh=self.config.mesh,
+                tracer=self.tracer)
+        except Exception as e:  # noqa: BLE001 — isolation boundary
+            busy = time.perf_counter() - t0
+            self.tracer.end_span(sid, wall_s=busy, error=repr(e))
+            self.stats.busy_s += busy
+            if len(jobs) == 1:
+                job = jobs[0]
+                self.stats.jobs_failed += 1
+                self.failures.append((job.job_id, repr(e)))
+                self.tracer.event("tenant_error", job_id=job.job_id,
+                                  slab_class=list(cls), error=repr(e))
+                return []
+            out = []
+            for job in jobs:  # isolate the poison job, save the rest
+                out.extend(self._dispatch([job], cls, "isolate", now))
+            return out
+        busy = time.perf_counter() - t0
+        self.tracer.end_span(sid, wall_s=busy, phases=br.n_phases)
+        if n_real:
+            self.stats.batches += 1
+            self.stats.rows_real += n_real
+            self.stats.rows_padded += b_pad
+        self.stats.busy_s += busy
+        if trigger == "linger":
+            self.stats.linger_dispatches += 1
+        out = []
+        for job, res in zip(jobs, br.results):
+            self.stats.jobs_done += 1
+            self.tracer.event(
+                "tenant_result", job_id=job.job_id,
+                slab_class=list(cls), q=float(res.modularity),
+                phases=len(res.phases),
+                iterations=int(res.total_iterations),
+                communities=int(res.num_communities),
+                wait_s=round(max(now - job.t_submit, 0.0), 6))
+            out.append((job.job_id, res))
+        return out
+
+    def step(self, now: float | None = None, force: bool = False) -> list:
+        """Run every due batch; returns [(job_id, LouvainResult), ...]
+        in submission order per batch.  One call may run several
+        batches (one per due bin); jobs whose clustering raised are
+        reported via ``self.failures``, not returned."""
+        now = self.clock() if now is None else now
+        out = []
+        for key in self._due(now, force):
+            cls, _acc = key
+            q = self._bins[key]
+            jobs = [q.popleft() for _ in range(min(len(q),
+                                                   self.config.b_max))]
+            full = len(jobs) >= self.config.b_max
+            trigger = "full" if full else "drain" if force else "linger"
+            out.extend(self._dispatch(jobs, cls, trigger, now))
+        return out
+
+    def drain(self) -> list:
+        """Flush every queued job regardless of linger/fill state."""
+        out = []
+        while self.pending():
+            out.extend(self.step(force=True))
+        return out

@@ -19,6 +19,23 @@ import os
 import warnings
 
 
+def request_host_devices(n: int) -> None:
+    """Ask XLA for ``n`` virtual CPU devices (batch-axis sharding,
+    ISSUE 9).  Must run BEFORE jax backend init — the flag is read once
+    at first backend touch — so CLI entry points call this right after
+    argument parsing and before any jax import.  No-op when ``n <= 1``
+    or when a device-count flag is already present (the test conftest,
+    an operator's explicit XLA_FLAGS): never silently override an
+    existing request."""
+    if n <= 1:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" in flags:
+        return
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
 def env_int(name: str, default: int, *, minimum: int = 1,
             maximum: int | None = None) -> int:
     """``int(os.environ[name], 0)`` clamped to [minimum, maximum], or

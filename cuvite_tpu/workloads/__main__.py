@@ -42,13 +42,29 @@ def _cmd_fetch(args) -> int:
 def _cmd_synth(args) -> int:
     import os
 
-    from cuvite_tpu.workloads.synth import synthesize
+    from cuvite_tpu.workloads.synth import synthesize, synthesize_many
 
     out = args.out
     if out is None:
         os.makedirs(DEFAULT_DATA_DIR, exist_ok=True)
         out = os.path.join(DEFAULT_DATA_DIR,
                            f"{args.profile}_{int(args.edges)}.vite")
+    if args.many:
+        # K small graphs on distinct splitmix64 streams, one provenance
+        # file for the set (serving benches/tests, ISSUE 9).
+        prefix = out[:-5] if out.endswith(".vite") else out
+        payload = synthesize_many(
+            prefix, args.many, edges=int(args.edges),
+            profile=args.profile, seed=args.seed, alpha=args.alpha,
+            mu=args.mu, overlap=args.overlap,
+            edge_factor=args.edge_factor, bits64=args.bits64,
+            write_truth=not args.no_truth,
+        )
+        print(json.dumps({
+            "out_prefix": prefix, "count": payload["count"],
+            "provenance": prefix + ".many.provenance.json",
+            "graphs": [m["path"] for m in payload["graphs"]]}))
+        return 0
     payload = synthesize(
         out, edges=int(args.edges), profile=args.profile, seed=args.seed,
         alpha=args.alpha, mu=args.mu, overlap=args.overlap,
@@ -139,6 +155,10 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--bits64", action="store_true")
     s.add_argument("--no-truth", action="store_true",
                    help="skip the ground-truth file (large graphs)")
+    s.add_argument("--many", type=int, metavar="K", default=0,
+                   help="emit K graphs <out>_<k>.vite on distinct "
+                        "splitmix64 streams with ONE set-level "
+                        "provenance file (serving benches/tests)")
 
     c = sub.add_parser("convert", help="convert SNAP/MTX/METIS to Vite")
     c.add_argument("input")

@@ -52,6 +52,7 @@ _T_MIX = 0x05 << 56
 _T_PICK = 0x06 << 56
 _T_INTRA = 0x07 << 56
 _T_INTER = 0x08 << 56
+_T_MANY = 0x09 << 56
 _STRIDE = 0x9E3779B97F4A7C15
 _MASK64 = (1 << 64) - 1
 
@@ -191,6 +192,104 @@ def write_provenance(out_path: str, payload: dict) -> str:
     return path
 
 
+def many_seed(seed: int, index: int) -> int:
+    """Per-graph seed of a ``--many`` set: splitmix64 of (seed, index)
+    on its own stream tag, so graph k is deterministic, independent of
+    the set size K, and never collides with the base generator's
+    streams (two members of one set share no draw)."""
+    return int(splitmix64(_stream_base(_T_MANY, seed)
+                          + np.uint64(index))) & ((1 << 62) - 1)
+
+
+def _layout(edges: int, spec: SynthSpec, seed: int):
+    """Shared degree/community layout of one synthesized graph."""
+    n_pairs = edges // 2
+    nv = max(64, edges // spec.edge_factor)
+    dmax = max(spec.dmin * 4, int(np.sqrt(nv) * 4))
+    vidx = np.arange(nv, dtype=np.int64)
+    u = _hash_u01(_T_DEGREE, vidx, seed)
+    wdeg = spec.dmin * np.power(1.0 - u, -1.0 / (spec.alpha - 1.0))
+    wdeg = np.minimum(wdeg, dmax)
+    draws = _exact_counts(wdeg, n_pairs)
+    bounds, sizes = _community_layout(nv, spec)
+    return nv, draws, bounds, sizes
+
+
+def synthesize_graph(edges: int, seed: int = 1, profile: str = "powerlaw",
+                     alpha: float = 2.3, mu: float = 0.25, dmin: int = 2,
+                     edge_factor: int = 16, comm_min: int = 16,
+                     comm_beta: float = 1.8, overlap: float = 0.05):
+    """In-memory variant of :func:`synthesize`: same deterministic draw
+    streams, returned as a built ``core.graph.Graph`` instead of a Vite
+    file — the shape serving benches and queue tests consume (ISSUE 9:
+    K small graphs per process, no filesystem round-trip).  The edge
+    SET matches what ``synthesize(...)`` would write for the same
+    parameters (symmetrized, duplicates coalesced by Graph.from_edges).
+    """
+    from cuvite_tpu.core.graph import Graph
+
+    if profile not in PROFILES:
+        raise ValueError(f"unknown profile {profile!r} "
+                         f"(choose from {PROFILES})")
+    edges = int(edges)
+    if edges < 4:
+        raise ValueError("need at least 4 directed edges")
+    spec = SynthSpec(profile=profile, edges=edges, seed=seed, alpha=alpha,
+                     mu=mu, dmin=dmin, edge_factor=edge_factor,
+                     comm_min=comm_min, comm_beta=comm_beta,
+                     overlap=overlap, bits64=False)
+    nv, draws, bounds, _sizes = _layout(edges, spec, seed)
+    srcs, dsts = [], []
+    for s, d, _w in _edge_chunk_stream(nv, draws, bounds, spec,
+                                       DEFAULT_CHUNK_EDGES):
+        srcs.append(s)
+        dsts.append(d)
+    src = np.concatenate(srcs) if srcs else np.zeros(0, dtype=np.int64)
+    dst = np.concatenate(dsts) if dsts else np.zeros(0, dtype=np.int64)
+    return Graph.from_edges(nv, src, dst, symmetrize=True)
+
+
+def synthesize_many(
+    out_prefix: str,
+    count: int,
+    edges: int,
+    seed: int = 1,
+    write_truth: bool = True,
+    **kw,
+) -> dict:
+    """K small deterministic power-law graphs in one call (the serving
+    bench/test workload): graph k is ``synthesize(...)`` under the
+    distinct :func:`many_seed` stream k, written to
+    ``<out_prefix>_<k>.vite``; ONE provenance file for the whole set at
+    ``<out_prefix>.many.provenance.json`` (each member still gets its
+    own, as every Vite artifact does)."""
+    count = int(count)
+    if count < 1:
+        raise ValueError("--many needs a positive graph count")
+    members = []
+    for k in range(count):
+        sk = many_seed(seed, k)
+        path = f"{out_prefix}_{k:04d}.vite"
+        payload = synthesize(
+            path, edges, seed=sk, write_truth=write_truth,
+            provenance_extra={"many": {"base_seed": seed, "index": k,
+                                       "count": count}},
+            **kw)
+        members.append({"path": path, "seed": sk,
+                        "sha256": payload["sha256"],
+                        "result": payload["result"]})
+    set_payload = {
+        "source": "synthesized-many",
+        "count": count,
+        "base_seed": seed,
+        "edges_each": int(edges),
+        "graphs": members,
+        "created": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    write_provenance(out_prefix + ".many", set_payload)
+    return set_payload
+
+
 def synthesize(
     out_path: str,
     edges: int,
@@ -225,15 +324,8 @@ def synthesize(
                      mu=mu, dmin=dmin, edge_factor=edge_factor,
                      comm_min=comm_min, comm_beta=comm_beta,
                      overlap=overlap, bits64=bits64)
-    n_pairs = edges // 2
-    nv = max(64, edges // edge_factor)
-    dmax = max(dmin * 4, int(np.sqrt(nv) * 4))
+    nv, draws, bounds, sizes = _layout(edges, spec, seed)
     vidx = np.arange(nv, dtype=np.int64)
-    u = _hash_u01(_T_DEGREE, vidx, seed)
-    wdeg = dmin * np.power(1.0 - u, -1.0 / (alpha - 1.0))
-    wdeg = np.minimum(wdeg, dmax)
-    draws = _exact_counts(wdeg, n_pairs)
-    bounds, sizes = _community_layout(nv, spec)
 
     stats = edges_to_vite(
         _edge_chunk_stream(nv, draws, bounds, spec, chunk_edges),

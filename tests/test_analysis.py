@@ -408,6 +408,34 @@ def tiny_row_sort(row):
 """,
         "cuvite_tpu/coarsen/fake_r013.py",
     ),
+    (
+        "R014",
+        """
+import jax
+
+def serve_loop(queue):
+    results = []
+    while queue:
+        job = queue.pop()
+        step = jax.jit(lambda s, d, w: s)   # fresh callable: compile per job
+        src = jax.device_put(job.src)       # upload per job
+        results.append(step(src, job.dst, job.w))
+    return results
+""",
+        """
+from cuvite_tpu.louvain.batched import cluster_many
+
+def serve_loop(queue, b_max):
+    results = []
+    while queue:
+        jobs = [queue.pop() for _ in range(min(len(queue), b_max))]
+        # one module-scope compiled program, one placement per batch
+        br = cluster_many([j.graph for j in jobs])
+        results.extend(br.results)
+    return results
+""",
+        "cuvite_tpu/serve/fake_r014.py",
+    ),
 ]
 
 RULE_IDS = [c[0] for c in RULE_CASES]

@@ -1,0 +1,307 @@
+"""Serving-layer tests (ISSUE 9): queue semantics, CLI paths, the
+``--many`` workload generator, and the ``batch`` bench schema + gate.
+
+The queue tests drive an injected clock, so linger deadlines are
+deterministic and no test sleeps.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from cuvite_tpu.core.batch import slab_class_of
+from cuvite_tpu.io.generate import generate_rmat
+from cuvite_tpu.louvain.driver import louvain_many
+from cuvite_tpu.serve import LouvainServer, ServeConfig
+from cuvite_tpu.workloads.bench import validate_record
+from cuvite_tpu.workloads.synth import (
+    many_seed,
+    synthesize_graph,
+    synthesize_many,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PERF_REGRESS = os.path.join(REPO, "tools", "perf_regress.py")
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture(scope="module")
+def small_graphs():
+    return [synthesize_graph(1024, seed=many_seed(3, k)) for k in range(5)]
+
+
+# ---------------------------------------------------------------------------
+# Queue discipline
+
+
+def test_full_bin_dispatches_immediately(small_graphs):
+    clock = FakeClock()
+    srv = LouvainServer(ServeConfig(b_max=2, linger_s=10.0), clock=clock)
+    srv.submit(small_graphs[0])
+    assert srv.step() == []          # one job, linger not reached
+    srv.submit(small_graphs[1])
+    done = srv.step()                # bin full at b_max=2
+    assert [jid for jid, _ in done] == ["job-0", "job-1"]
+    assert srv.pending() == 0
+    assert srv.stats.batches == 1 and srv.stats.pack_util == 1.0
+    assert srv.stats.linger_dispatches == 0
+
+
+def test_linger_deadline_dispatches_partial(small_graphs):
+    clock = FakeClock()
+    srv = LouvainServer(ServeConfig(b_max=8, linger_s=0.5), clock=clock)
+    jid = srv.submit(small_graphs[0])
+    assert srv.step() == []          # fresh: waits for batch mates
+    clock.t += 0.6                   # oldest job passes the deadline
+    done = srv.step()
+    assert [j for j, _ in done] == [jid]
+    assert srv.stats.linger_dispatches == 1
+    # A lone job pads to the B=1 rung: no padding tax.
+    assert srv.stats.pack_util == 1.0
+
+
+def test_classes_bin_separately(small_graphs):
+    big = generate_rmat(13, edge_factor=8, seed=1)
+    assert slab_class_of(big) != slab_class_of(small_graphs[0])
+    srv = LouvainServer(ServeConfig(b_max=4, linger_s=0.0),
+                        clock=FakeClock())
+    srv.submit(small_graphs[0])
+    srv.submit(big)
+    srv.submit(small_graphs[1])
+    done = dict(srv.drain())
+    assert len(done) == 3
+    assert srv.stats.batches == 2, "two classes -> two batches"
+
+
+def test_serve_results_match_direct_runs(small_graphs):
+    srv = LouvainServer(ServeConfig(b_max=4, linger_s=0.0),
+                        clock=FakeClock())
+    ids = [srv.submit(g) for g in small_graphs[:4]]
+    done = dict(srv.drain())
+    for jid, g in zip(ids, small_graphs):
+        direct = louvain_many([g]).results[0]
+        assert done[jid].modularity == direct.modularity
+        assert np.array_equal(done[jid].communities, direct.communities)
+    assert srv.stats.jobs_done == 4 and srv.stats.jobs_per_s > 0
+
+
+def test_pack_span_and_tenant_events(small_graphs):
+    from cuvite_tpu.obs import MemoryTraceSink, FlightRecorder, spans_of
+    from cuvite_tpu.utils.trace import Tracer
+
+    sink = MemoryTraceSink()
+    rec = FlightRecorder(sink, watch_compiles=False)
+    srv = LouvainServer(ServeConfig(b_max=2, linger_s=0.0),
+                        clock=FakeClock(), tracer=Tracer(recorder=rec))
+    with rec:
+        srv.submit(small_graphs[0])
+        srv.submit(small_graphs[1])
+        srv.step()
+    packs = spans_of(sink.records, "pack")
+    assert len(packs) == 1
+    pk = packs[0]
+    assert pk["begin"]["attrs"]["jobs"] == 2
+    assert pk["begin"]["attrs"]["b_pad"] == 2
+    assert pk["begin"]["attrs"]["trigger"] == "full"
+    assert pk["end"] is not None and "wall_s" in pk["end"]["attrs"]
+    tenants = [r for r in sink.records
+               if r.get("t") == "event" and r.get("name") == "tenant_result"]
+    assert len(tenants) == 2
+    assert {"job_id", "q", "phases", "communities",
+            "wait_s"} <= set(tenants[0]["attrs"])
+
+
+def test_poison_job_isolated_not_batch_fatal(small_graphs):
+    """A job whose packing/clustering raises must not take its
+    batchmates down or vanish: the batch splits, good jobs complete,
+    the poison job lands in server.failures."""
+    from cuvite_tpu.core.graph import Graph
+
+    poison = Graph.from_edges(4, np.array([0]), np.array([1]),
+                              weights=np.array([0.0]))  # 2m == 0
+    srv = LouvainServer(ServeConfig(b_max=4, linger_s=0.0),
+                        clock=FakeClock())
+    good = [srv.submit(g) for g in small_graphs[:2]]
+    bad = srv.submit(poison)
+    done = dict(srv.drain())
+    assert set(done) == set(good), "batchmates must survive"
+    assert srv.stats.jobs_failed == 1
+    assert [jid for jid, _ in srv.failures] == [bad]
+    assert srv.pending() == 0, "a poison job must never re-queue"
+    for jid, g in zip(good, small_graphs):
+        assert np.array_equal(done[jid].communities,
+                              louvain_many([g]).results[0].communities)
+
+
+def test_accumulator_classes_bin_separately(small_graphs):
+    """A ds32-scale tenant must not drag same-shape f32 tenants onto
+    the ds32 program (it would silently change their results vs solo):
+    the queue bins by accumulator class, and the packer refuses a
+    mixed batch outright."""
+    from cuvite_tpu.core.graph import Graph
+    from cuvite_tpu.louvain.batched import accum_class_of, cluster_many
+
+    heavy = Graph.from_edges(
+        8, np.array([0, 1]), np.array([1, 2]),
+        weights=np.array([2.0 ** 25, 2.0 ** 25]))
+    light = small_graphs[0]
+    assert accum_class_of(heavy) == "ds32"
+    assert accum_class_of(light) == "float32"
+    assert slab_class_of(heavy) == slab_class_of(light)
+    with pytest.raises(ValueError, match="mixed accumulator"):
+        cluster_many([light, heavy])
+    srv = LouvainServer(ServeConfig(b_max=4, linger_s=0.0),
+                        clock=FakeClock())
+    srv.submit(light)
+    srv.submit(heavy)
+    done = dict(srv.drain())
+    assert len(done) == 2 and srv.stats.batches == 2
+    assert srv.stats.jobs_failed == 0
+
+
+def test_b_max_rounds_to_ladder_rung():
+    assert ServeConfig(b_max=10).b_max == 16
+    assert ServeConfig(b_max=64).b_max == 64
+    assert ServeConfig(b_max=1000).b_max == 64
+    with pytest.raises(ValueError):
+        ServeConfig(b_max=0)
+
+
+# ---------------------------------------------------------------------------
+# CLI paths
+
+
+def test_cluster_many_cli(tmp_path, capsys):
+    from cuvite_tpu.serve.__main__ import main as serve_main
+
+    prefix = str(tmp_path / "set")
+    synthesize_many(prefix, 2, 1024, seed=5, write_truth=False)
+    files = [f"{prefix}_{k:04d}.vite" for k in range(2)]
+    rc = serve_main(["cluster-many", *files, "--output", "--json",
+                     "--host-devices", "1", "--b-max", "2",
+                     "--linger-ms", "0"])
+    assert rc == 0
+    lines = [json.loads(x) for x in
+             capsys.readouterr().out.strip().splitlines()]
+    summary = lines[-1]["summary"]
+    assert summary["jobs_done"] == 2 and summary["batches"] == 1
+    for f in files:
+        out = f + ".communities"
+        assert os.path.exists(out)
+        labels = np.loadtxt(out, dtype=np.int64)
+        assert labels.ndim == 1 and labels.min() >= 0
+
+
+# ---------------------------------------------------------------------------
+# synth --many
+
+
+def test_synth_many_deterministic_and_distinct(tmp_path):
+    p1 = synthesize_many(str(tmp_path / "a"), 3, 1024, seed=9,
+                         write_truth=False)
+    p2 = synthesize_many(str(tmp_path / "b"), 3, 1024, seed=9,
+                         write_truth=False)
+    sha1 = [m["sha256"] for m in p1["graphs"]]
+    sha2 = [m["sha256"] for m in p2["graphs"]]
+    assert sha1 == sha2, "same (seed, index) must be byte-identical"
+    assert len(set(sha1)) == 3, "distinct streams per member"
+    # ONE provenance file for the set, naming every member.
+    setp = json.load(open(str(tmp_path / "a") + ".many.provenance.json"))
+    assert setp["source"] == "synthesized-many" and setp["count"] == 3
+    assert len(setp["graphs"]) == 3
+    # member k is independent of the set size K
+    assert many_seed(9, 1) == many_seed(9, 1)
+    assert many_seed(9, 1) != many_seed(9, 2)
+
+
+def test_synthesize_graph_matches_stream(small_graphs):
+    g1 = synthesize_graph(1024, seed=many_seed(3, 0))
+    assert g1.num_vertices == small_graphs[0].num_vertices
+    assert np.array_equal(g1.tails, small_graphs[0].tails)
+
+
+# ---------------------------------------------------------------------------
+# `batch` bench block + perf_regress gate
+
+
+@pytest.fixture(scope="module")
+def batch_record():
+    from cuvite_tpu.workloads.bench import run_batch_bench
+
+    return run_batch_bench(B=2, n_jobs=4, edges=1024, repeats=1,
+                           budget_s=600.0, platform="cpu")
+
+
+def test_batch_record_schema_valid(batch_record):
+    assert validate_record(batch_record) == []
+    blk = batch_record["batch"]
+    assert blk["B"] == 2 and blk["n_jobs"] == 4 and blk["batches"] == 2
+    assert blk["pack_util"] == 1.0
+    assert blk["jobs_per_s"] > 0
+    assert blk["class"] == list((4096, 16384))
+    assert batch_record["engine"] == "batched"
+
+
+def test_batch_block_validation_rejects_malformed(batch_record):
+    rec = dict(batch_record)
+    rec["batch"] = {"B": 2, "jobs_per_s": 5.0}  # pack_util missing
+    assert any("pack_util" in p for p in validate_record(rec))
+    rec["batch"] = dict(batch_record["batch"], pack_util=1.5)
+    assert any("pack_util" in p for p in validate_record(rec))
+    rec["batch"] = dict(batch_record["batch"], jobs_per_s=0)
+    assert any("jobs_per_s" in p for p in validate_record(rec))
+    rec["batch"] = dict(batch_record["batch"], B="two")
+    assert any("batch.B" in p for p in validate_record(rec))
+
+
+def _round_log(path, rec, n=97):
+    with open(path, "w") as f:
+        json.dump({"n": n, "cmd": "test", "rc": 0, "tail": "",
+                   "parsed": rec}, f)
+
+
+def _gate(tmp_path, fresh, peer):
+    fresh_p = tmp_path / "fresh.json"
+    fresh_p.write_text(json.dumps(fresh))
+    _round_log(tmp_path / "BENCH_r97.json", peer)
+    return subprocess.run(
+        [sys.executable, PERF_REGRESS, "--record", str(fresh_p),
+         "--bench-glob", str(tmp_path / "BENCH_r9*.json")],
+        capture_output=True, text=True, timeout=120)
+
+
+def test_perf_regress_gates_jobs_per_s(tmp_path, batch_record):
+    peer = json.loads(json.dumps(batch_record))
+    peer["batch"]["jobs_per_s"] = batch_record["batch"]["jobs_per_s"] * 2
+    out = _gate(tmp_path, batch_record, peer)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "batch jobs_per_s" in out.stderr
+
+
+def test_perf_regress_passes_like_for_like(tmp_path, batch_record):
+    out = _gate(tmp_path, batch_record, json.loads(
+        json.dumps(batch_record)))
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_perf_regress_ignores_other_batch_configs(tmp_path, batch_record):
+    """A record at a different B (or a non-batch record) is not a peer:
+    first record of a new serving config is a baseline."""
+    peer = json.loads(json.dumps(batch_record))
+    peer["batch"]["B"] = 64
+    peer["batch"]["jobs_per_s"] = 1e9
+    peer["value"] = batch_record["value"] * 100
+    out = _gate(tmp_path, batch_record, peer)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 comparable" in out.stdout

@@ -108,6 +108,17 @@ def comparable(fresh: dict, rec: dict) -> bool:
     if ("engine" in fresh and "engine" in rec
             and fresh["engine"] != rec["engine"]):
         return False
+    # Batched serving records (ISSUE 9) gate like-for-like only: same
+    # padded batch size AND same slab class — jobs/sec at B=64 on the
+    # (4096, 16384) class says nothing about B=8 or a bigger class.
+    fb, rb = fresh.get("batch"), rec.get("batch")
+    if (fb is None) != (rb is None):
+        return False
+    if fb is not None:
+        if fb.get("B") != rb.get("B"):
+            return False
+        if fb.get("class") != rb.get("class"):
+            return False
     return True
 
 
@@ -133,6 +144,27 @@ def check_regression(fresh: dict, trajectory: list, threshold: float,
             f"{1.0 - fresh['value'] / best['value']:.0%} below the "
             f"trajectory best {best['value']:.3g} (round {best_n}); "
             f"gate allows {threshold:.0%}")
+    # Serving-throughput gate (ISSUE 9): jobs_per_s of a batched record
+    # against the best comparable batched record (comparable() already
+    # pinned class and B).
+    if isinstance(fresh.get("batch"), dict):
+        bpeers = [(n, rec) for n, rec in peers
+                  if isinstance(rec.get("batch"), dict)
+                  and isinstance(rec["batch"].get("jobs_per_s"),
+                                 (int, float))]
+        if bpeers and isinstance(fresh["batch"].get("jobs_per_s"),
+                                 (int, float)):
+            bn, bbest = max(bpeers,
+                            key=lambda p: p[1]["batch"]["jobs_per_s"])
+            old_jps = bbest["batch"]["jobs_per_s"]
+            new_jps = fresh["batch"]["jobs_per_s"]
+            if new_jps < old_jps * (1.0 - threshold):
+                problems.append(
+                    f"batch jobs_per_s {new_jps:.3g} is "
+                    f"{1.0 - new_jps / old_jps:.0%} below the trajectory "
+                    f"best {old_jps:.3g} (round {bn}, B="
+                    f"{fresh['batch'].get('B')}); gate allows "
+                    f"{threshold:.0%}")
     # Stage-level gate: against the most recent comparable record that
     # carries stages (schema v2+ — early rounds predate the breakdown).
     staged = [(n, rec) for n, rec in peers

@@ -18,7 +18,10 @@ valuable result first):
       rmat-18 and rmat-20 (--json lines logged); on a multi-chip slice
       also bucketed vs pallas SPMD over all devices;
   E.  bench at scale 22;
-  then tools/heavy_ab.py (heavy-class kernel decision measurement).
+  then tools/heavy_ab.py (heavy-class kernel decision measurement),
+  stage F (seg-coalesce fullrun A/B, ISSUE 8) and stage G (batched
+  multi-tenant serving at B in {1, 8, 64} — jobs/sec + pack_util,
+  ISSUE 9).
 
 Success marker: tools/TPU_LADDER3_DONE (platform!=cpu bench JSON
 landed).  Every result appends to tools/logs/tpu_ladder_r4.log immediately.
@@ -247,6 +250,34 @@ def stage_e():
             pass
 
 
+def stage_g():
+    """Batched multi-tenant serving bench at B in {1, 8, 64} (ISSUE 9):
+    jobs/sec + pack_util through the batched driver on-chip, staged
+    next to the seg-coalesce A/B so the first platform=tpu record can
+    cover both.  On a TPU slice the batch axis shards over the chips
+    (louvain/batched.py BATCH_AXIS); each B writes its own JSON the
+    moment it exists."""
+    for b in (1, 8, 64):
+        out_path = os.path.join(REPO, f"tools/bench_tpu_batch_b{b}.json")
+        t0 = time.perf_counter()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-m", "cuvite_tpu.workloads", "bench",
+                 "--batch", str(b), "--repeats", "3",
+                 "--out", out_path],
+                capture_output=True, text=True, timeout=1800, cwd=REPO)
+        except subprocess.TimeoutExpired:
+            log(f"G: batch B={b} TIMEOUT (1800s)")
+            continue
+        last = out.stdout.strip().splitlines()
+        log(f"G: batch B={b} rc={out.returncode} "
+            f"wall={time.perf_counter()-t0:.0f}s "
+            f"json={last[-1] if last else out.stderr[-200:]}")
+        if out.returncode == 3:
+            log("G: compile guard tripped — a timed batch recompiled; "
+                "no JSON by design")
+
+
 def main():
     parts = probe()
     if parts is None:
@@ -308,6 +339,11 @@ def main():
                        timeout=3600, env=env)
     except subprocess.TimeoutExpired:
         log("fullrun_ab (seg-coalesce stage F): TIMEOUT (3600s)")
+    # Stage G (ISSUE 9): batched serving at B in {1, 8, 64}.
+    try:
+        stage_g()
+    except Exception as e:
+        log(f"G: FAILED {type(e).__name__}: {e}")
     if got_tpu_json:
         with open(DONE, "w") as f:
             f.write(time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()) + "\n")
