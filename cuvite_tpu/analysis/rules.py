@@ -1,4 +1,4 @@
-"""graftlint rule set R001..R014 (see ANALYSIS.md for the catalogue).
+"""graftlint rule set R001..R015 (see ANALYSIS.md for the catalogue).
 
 Each rule targets a hazard class this codebase has actually hit (or is
 one refactor away from hitting): host syncs inside jitted code, jit
@@ -11,8 +11,9 @@ verification), device->host pulls in phase-transition code, Pallas
 block shapes not derived from the static width-ladder constants, and
 bench timing windows that close without forcing device completion,
 full-slab sorts in coarsen/kernels outside the sanctioned coalesce
-fallback chokepoint, and compile/upload-per-job traps in serving queue
-loops.
+fallback chokepoint, compile/upload-per-job traps in serving queue
+loops, and bucket-plan construction inside serve/ dispatch loops
+(planning belongs at pack time).
 
 Rules are heuristic by design: they trade completeness for a near-zero
 false-positive rate on idiomatic code, and every remaining intentional
@@ -993,6 +994,26 @@ _SERVE_LOOP_TRAPS = {
 }
 
 
+def _serve_loop_calls(sf, names):
+    """(node, fname) for every call of ``names`` lexically inside a
+    for/while loop of a serve/ module — the shared traversal of the
+    per-job amortization-trap rules (R014 compile/upload, R015 plan
+    construction), so their loop/scope semantics cannot drift."""
+    if not sf.rel.startswith(_SERVE_SCOPE):
+        return
+    seen: set = set()
+    for loop in sf.walk():
+        if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        for node in ast.walk(loop):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            fname = dotted(node.func)
+            if fname in names:
+                seen.add(id(node))
+                yield node, fname
+
+
 @register
 class ServeLoopCompileTrap(Rule):
     id = "R014"
@@ -1001,29 +1022,57 @@ class ServeLoopCompileTrap(Rule):
             "serve/ queue loop"
 
     def check(self, sf):
-        if not sf.rel.startswith(_SERVE_SCOPE):
-            return
-        seen: set = set()
-        for loop in sf.walk():
-            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
-                continue
-            for node in ast.walk(loop):
-                if not isinstance(node, ast.Call) or id(node) in seen:
-                    continue
-                fname = dotted(node.func)
-                if fname in _SERVE_LOOP_TRAPS:
-                    seen.add(id(node))
-                    what = ("recompiles per job (jit caches per "
-                            "callable identity)"
-                            if fname in _JIT_NAMES
-                            or fname in ("jax.vmap", "jax.pmap")
-                            else "re-uploads per job")
-                    yield self.finding(
-                        sf, node,
-                        f"{fname}() inside a serve/ queue loop {what}: "
-                        "the batched serving contract is ONE compiled "
-                        "program per (slab class, B) at module scope "
-                        "(louvain/batched.py) and ONE device placement "
-                        "per packed batch (run_batched); hoist it out "
-                        "of the loop, or justify with an inline "
-                        "'# graftlint: disable=R014'")
+        for node, fname in _serve_loop_calls(sf, _SERVE_LOOP_TRAPS):
+            what = ("recompiles per job (jit caches per "
+                    "callable identity)"
+                    if fname in _JIT_NAMES
+                    or fname in ("jax.vmap", "jax.pmap")
+                    else "re-uploads per job")
+            yield self.finding(
+                sf, node,
+                f"{fname}() inside a serve/ queue loop {what}: "
+                "the batched serving contract is ONE compiled "
+                "program per (slab class, B) at module scope "
+                "(louvain/batched.py) and ONE device placement "
+                "per packed batch (run_batched); hoist it out "
+                "of the loop, or justify with an inline "
+                "'# graftlint: disable=R014'")
+
+
+# ---------------------------------------------------------------------------
+# R015: bucket-plan construction inside serve/ dispatch loops (ISSUE
+# 10).  The batched BUCKETED engine's whole premise is that planning
+# happens ONCE per packed batch, at pack time: run_batched calls
+# core.batch.batch_bucket_plans (one O(sum E) host pass covering every
+# row) before any device work.  A BucketPlan.build /
+# build_stacked_plans / batch_bucket_plans call inside a serve/
+# for-or-while loop is the plan-PER-JOB trap: it rebuilds O(E) gather
+# matrices per tenant per dispatch, turning the pack-time amortization
+# into per-job host work — results unchanged, throughput silently
+# gone, exactly the regression class R014 guards on the compile side.
+
+_PLAN_BUILD_CALLS = {
+    "BucketPlan.build", "bucketed.BucketPlan.build",
+    "build_stacked_plans", "bucketed.build_stacked_plans",
+    "batch_bucket_plans", "batch.batch_bucket_plans",
+}
+
+
+@register
+class ServeLoopPlanTrap(Rule):
+    id = "R015"
+    severity = "high"
+    title = "bucket-plan construction inside a serve/ dispatch loop " \
+            "(planning belongs at pack time)"
+
+    def check(self, sf):
+        for node, fname in _serve_loop_calls(sf, _PLAN_BUILD_CALLS):
+            yield self.finding(
+                sf, node,
+                f"{fname}() inside a serve/ dispatch loop builds "
+                "bucket plans per job: planning belongs at PACK "
+                "time — one batch_bucket_plans call per packed "
+                "batch inside run_batched (louvain/batched.py), "
+                "covering every row in one host pass; hoist the "
+                "plan construction out of the loop, or justify "
+                "with an inline '# graftlint: disable=R015'")

@@ -42,6 +42,14 @@ MIN_NE_PAD = 16384
 # queue's compile footprint stays bounded per slab class.
 BATCH_SIZES = (1, 2, 4, 8, 16, 32, 64)
 
+# THE batched per-phase engine vocabulary (ISSUE 10), defined here —
+# the one jax-free module every consumer (louvain/batched.py driver,
+# serve/queue.py config validation, workloads/bench.py record schema +
+# CLI, serve/__main__.py CLI) already can import before jax initializes
+# — so the list cannot drift across its four call sites.  Semantics
+# live with the driver: see louvain/batched.py.
+BATCH_ENGINES = ("fused", "bucketed")
+
 
 def slab_class_of(graph) -> tuple:
     """The pow2 slab class ``(nv_pad, ne_pad)`` this graph canonicalizes
@@ -191,4 +199,211 @@ def batch_slabs(graphs, *, b_pad: int | None = None,
         src=src, dst=dst, w=w, real_mask=real_mask, constant=constant,
         row_valid=row_valid, nv_real=nv_real, ne_real=ne_real, tw2=tw2,
         nv_pad=nv_pad, ne_pad=ne_pad, n_jobs=n,
+    )
+
+
+# --- batched bucket plans (ISSUE 10) ---------------------------------------
+# The fused batched program sweeps via the packed 2-channel lax.sort — the
+# exact per-row cost the per-graph bucketed engine exists to avoid.  To run
+# B tenants through ONE vmapped bucketed step, the per-graph BucketPlans
+# (per-graph kept widths, per-graph pow2 row counts) must be padded to a
+# COMMON cross-graph geometry: kept widths = the union across the batch,
+# each width's row count = the batch max (counts are pow2 already, so the
+# max is pow2), absent rows flag-masked with the same verts == nv_pad
+# sentinel that retires converged rows' slabs.  The result stacks to
+# [B, rows, width] per-width matrices — the multi-tenant analog of
+# louvain/bucketed.py::build_stacked_plans' per-SHARD common padding.
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketShape:
+    """Static geometry of a batched bucket plan: the compile key of the
+    batched bucketed phase program beyond ``(class, B)``.  Pinning one
+    shape across many batches (``bucket_shape_for`` over the whole job
+    set — the bench does) keeps every batch on one compiled program even
+    when per-batch degree histograms differ."""
+
+    widths: tuple    # kept bucket widths, ascending
+    rows: tuple      # per-width common padded row count (pow2)
+    heavy_pad: int   # heavy-residual slab length (pow2, >= 8)
+
+    def fits(self, other: "BucketShape") -> bool:
+        """True when every requirement of ``other`` fits inside self."""
+        mine = dict(zip(self.widths, self.rows))
+        return (all(w in mine and r <= mine[w]
+                    for w, r in zip(other.widths, other.rows))
+                and other.heavy_pad <= self.heavy_pad)
+
+
+def union_shapes(a: BucketShape, b: BucketShape) -> BucketShape:
+    """The smallest geometry covering both ``a`` and ``b`` (union of
+    kept widths, per-width max rows, max heavy pad).  The serving queue
+    pins each bin's geometry to the grow-only union of every batch it
+    has dispatched: a repeat of any seen geometry then reuses the
+    compiled phase-0 program, and because shapes only grow — and are
+    bounded by the slab class — the compile count per bin converges
+    instead of churning with per-batch degree histograms."""
+    rows: dict = {}
+    for shape in (a, b):
+        for w, r in zip(shape.widths, shape.rows):
+            rows[w] = max(rows.get(w, 0), r)
+    ws = tuple(sorted(rows))
+    return BucketShape(widths=ws, rows=tuple(rows[w] for w in ws),
+                       heavy_pad=max(a.heavy_pad, b.heavy_pad))
+
+
+@dataclasses.dataclass
+class BatchedBucketPlan:
+    """Per-graph BucketPlans padded to one cross-graph geometry and
+    stacked on the batch axis, ready for the vmapped bucketed step.
+
+    Pad rows (``row_valid`` false) and absent (graph, width) pairs carry
+    pure plan padding: ``verts == nv_pad`` rows that every scatter drops
+    and the assembly perm never points at — bit-for-bit the same masking
+    contract as the retired-slab rows of the fused batched phase."""
+
+    buckets: list            # (verts [B, Nb], dst [B, Nb, D], w [B, Nb, D])
+    heavy: tuple             # (src [B, H], dst [B, H], w [B, H])
+    self_loop: np.ndarray    # [B, nv_pad]
+    perm: np.ndarray         # [B, nv_pad] int32 assembly permutation
+    shape: BucketShape
+    nv_pad: int
+
+
+def _plan_shape_req(deg: np.ndarray, widths: tuple) -> tuple:
+    """(per-width padded row counts [len(widths)], heavy_pad) that
+    BucketPlan.build would produce for a vertex-degree vector — the
+    slab-free derivation behind ``bucket_shape_for``.  It REPLICATES
+    BucketPlan.build's binning/padding rules (width bins, pow2 row
+    rounding, the heavy pow2-with-floor-8 pad) rather than calling
+    them, so the parity is pinned by test, not construction:
+    tests/test_batched.py::test_batch_bucket_plans_geometry asserts the
+    degree-derived shape equals the one batch_bucket_plans reads off
+    the built plans — a padding-rule change that edits only one side
+    fails there."""
+    widths_arr = np.asarray(widths, dtype=np.int64)
+    rows = np.zeros(len(widths), dtype=np.int64)
+    prev = 0
+    for k, width in enumerate(widths):
+        nb = int(np.count_nonzero((deg > prev) & (deg <= width)))
+        prev = width
+        if nb:
+            rows[k] = 1 << int(nb - 1).bit_length() if nb > 1 else 1
+    n_h = int(deg[deg > widths_arr[-1]].sum())
+    heavy_pad = max(int(2 ** np.ceil(np.log2(max(n_h, 1)))), 8) if n_h else 8
+    return rows, heavy_pad
+
+
+def bucket_shape_for(graphs, widths: tuple | None = None) -> BucketShape:
+    """The common :class:`BucketShape` covering every graph of a job set
+    — pure host degree arithmetic (no slab or plan is built), so a bench
+    or a shape-pinning caller can compute it over thousands of jobs
+    cheaply.  Width binning depends only on vertex degrees, which the
+    packed slab preserves, so this matches what ``batch_bucket_plans``
+    derives from the slabs themselves (shared ``_plan_shape_req``)."""
+    from cuvite_tpu.louvain.bucketed import DEFAULT_BUCKETS
+
+    widths = DEFAULT_BUCKETS if widths is None else tuple(widths)
+    rows = np.zeros(len(widths), dtype=np.int64)
+    heavy_pad = 8
+    for g in graphs:
+        r, h = _plan_shape_req(np.asarray(g.degrees(), dtype=np.int64),
+                               widths)
+        rows = np.maximum(rows, r)
+        heavy_pad = max(heavy_pad, h)
+    kept = rows > 0
+    return BucketShape(
+        widths=tuple(int(w) for w, k in zip(widths, kept) if k),
+        rows=tuple(int(r) for r in rows[kept]),
+        heavy_pad=int(heavy_pad),
+    )
+
+
+def batch_bucket_plans(batch: BatchedSlab,
+                       shape: BucketShape | None = None
+                       ) -> BatchedBucketPlan:
+    """Build one :class:`BucketPlan` per batch row AT PACK TIME and pad
+    them to a common cross-graph geometry (see module note above).
+
+    ``shape``: pin an explicit geometry (every row pads UP into it; a
+    row needing a width/row-count/heavy-pad the shape lacks raises) —
+    the bench pins the job-set union so every chunk reuses one compiled
+    phase-0 program.  Default: the union/batch-max geometry of THIS
+    batch.  Pad rows are all-padding slabs, so their plans are empty —
+    they contribute only sentinel rows that cost two masked sweeps."""
+    from cuvite_tpu.louvain.bucketed import (
+        DEFAULT_BUCKETS,
+        BucketPlan,
+        build_assemble_perm,
+    )
+
+    nv = batch.nv_pad
+    B = batch.b_pad
+    widths = DEFAULT_BUCKETS
+    # Pad rows included: BucketPlan.build on an all-padding slab is the
+    # empty plan (no buckets, padding heavy, zero self-loops) — uniform
+    # construction keeps the stacking loop branch-free.
+    plans = [
+        BucketPlan.build(batch.src[i], batch.dst[i], batch.w[i],
+                         nv_local=nv, base=0, widths=widths)
+        for i in range(B)
+    ]
+    by_width = [{b.width: b for b in p.buckets} for p in plans]
+    req = np.zeros(len(widths), dtype=np.int64)
+    for bw in by_width:
+        for k, w in enumerate(widths):
+            if w in bw:
+                req[k] = max(req[k], len(bw[w].verts))
+    heavy_req = max(max((len(p.heavy_src) for p in plans), default=8), 8)
+    kept = req > 0
+    need = BucketShape(
+        widths=tuple(int(w) for w, k in zip(widths, kept) if k),
+        rows=tuple(int(r) for r in req[kept]),
+        heavy_pad=int(heavy_req),
+    )
+    if shape is None:
+        shape = need
+    elif not shape.fits(need):
+        raise ValueError(
+            f"batch_bucket_plans: batch needs geometry {need} which does "
+            f"not fit the pinned shape {shape} — pin a shape covering "
+            "the whole job set (core.batch.bucket_shape_for)")
+
+    # Weights stay f32 — deliberately NOT the per-graph upload's uint8
+    # unit-weight compression: that eligibility is a property of batch
+    # CONTENT, and a per-bucket dtype flip would fold content into the
+    # compile key (measured: one mixed-weight tenant in an otherwise
+    # unit-weight class recompiles the whole phase-0 program).  Serving
+    # wants a stable (class, B, geometry) key more than the 4x upload
+    # saving on unit-weight buckets.
+    wdt = np.dtype(np.float32)
+    buckets = []
+    for width, nb in zip(shape.widths, shape.rows):
+        verts = np.full((B, nb), nv, dtype=np.int64)
+        dmat = np.zeros((B, nb, width), dtype=np.int32)
+        wmat = np.zeros((B, nb, width), dtype=wdt)
+        for i, bw in enumerate(by_width):
+            if width in bw:
+                b = bw[width]
+                n = len(b.verts)
+                verts[i, :n] = b.verts
+                dmat[i, :n] = b.dst
+                wmat[i, :n] = b.w
+        buckets.append((verts, dmat, wmat))
+    hs = np.full((B, shape.heavy_pad), nv, dtype=np.int32)
+    hd = np.zeros((B, shape.heavy_pad), dtype=np.int32)
+    hw = np.zeros((B, shape.heavy_pad), dtype=wdt)
+    self_loop = np.zeros((B, nv), dtype=wdt)
+    for i, p in enumerate(plans):
+        hs[i, : len(p.heavy_src)] = p.heavy_src
+        hd[i, : len(p.heavy_dst)] = p.heavy_dst
+        hw[i, : len(p.heavy_w)] = p.heavy_w
+        self_loop[i] = p.self_loop
+    perm = np.stack([
+        build_assemble_perm([bk[0][i] for bk in buckets], nv)
+        for i in range(B)
+    ]) if B else np.zeros((0, nv), dtype=np.int32)
+    return BatchedBucketPlan(
+        buckets=buckets, heavy=(hs, hd, hw), self_loop=self_loop,
+        perm=perm, shape=shape, nv_pad=nv,
     )

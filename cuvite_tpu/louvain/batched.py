@@ -66,14 +66,24 @@ from cuvite_tpu.coarsen.device import (
     batched_compose_labels,
     batched_renumber,
 )
-from cuvite_tpu.core.batch import BatchedSlab, batch_slabs
+from cuvite_tpu.core.batch import BATCH_ENGINES, BatchedSlab, batch_slabs
 from cuvite_tpu.core.types import (
     MAX_TOTAL_ITERATIONS,
     TERMINATION_PHASE_COUNT,
 )
 from cuvite_tpu.louvain.fused import fused_phase
 from cuvite_tpu.obs.convergence import decode_phase_conv
+from cuvite_tpu.ops import segment as seg
 from cuvite_tpu.utils.upload import to_device
+
+# Batched engines (canonical tuple: core.batch.BATCH_ENGINES, re-
+# exported above): 'fused' — vmapped fused phase loop (the packed
+# 2-channel lax.sort sweep) every phase; 'bucketed' — phase 0 runs the
+# vmapped BUCKETED sweep over cross-graph-padded plans (ISSUE 10; the
+# sort-free formulation every per-graph benchmark shows is the fast
+# one), phases >= 1 keep the fused loop (coarse graphs are small, and
+# re-binning their plans would need a device-side histogram).  The
+# per-phase engine actually used is recorded in BatchResult.phase_engines.
 
 
 def _phase_body(src, dst, w, comm_all, real_mask, prev_mod, active,
@@ -94,7 +104,6 @@ def _phase_body(src, dst, w, comm_all, real_mask, prev_mod, active,
     single-device program, or wrapped per-shard by
     :func:`_get_batched_phase` when the batch axis is sharded.
     """
-    wdt = w.dtype
     adt = accum_dtype
 
     past, mod, iters, _ovf, (cq, cmoved, covf) = jax.vmap(
@@ -103,6 +112,71 @@ def _phase_body(src, dst, w, comm_all, real_mask, prev_mod, active,
             max_iters=max_iters)
     )(src, dst, w, constant)
 
+    return _phase_tail(
+        src, dst, w, comm_all, real_mask, prev_mod, active, threshold,
+        past, mod, iters, cq, cmoved, covf,
+        nv_pad=nv_pad, accum_dtype=accum_dtype, coalesce=coalesce)
+
+
+def _bucketed_phase_body(buckets, heavy, self_loop, perm, src, dst, w,
+                         comm_all, real_mask, prev_mod, active, constant,
+                         threshold, *, nv_pad, accum_dtype, coalesce,
+                         max_iters=MAX_TOTAL_ITERATIONS):
+    """The sort-free phase: the per-graph BUCKETED sweep lifted over the
+    batch axis (ISSUE 10).  Same contract as :func:`_phase_body`, plus
+    the batched plan arrays (core/batch.py::batch_bucket_plans) ahead of
+    the slab state.
+
+    The row sweep is literally the per-graph bucketed driver's phase
+    loop — ``driver._run_phase_loop`` over ``driver._bucketed_call``
+    (identity start, on-device convergence check, the degree-bucketed
+    dense row formulation of Naim et al., arXiv:1805.10904) — vmapped,
+    so per-tenant labels stay bit-identical to a B=1 run.  Engine
+    degradations under vmap: no Pallas row-argmax flags and no promoted
+    heavy-kernel layout (their grids do not lift over a batch axis; the
+    XLA paths they degrade to are bit-identical, the batched-coalesce
+    precedent), and the heavy residual runs the sorted path on its
+    (usually 8-slot padding) slab.  The slab itself is swept ONLY for
+    the per-row weighted degrees — no per-iteration ne_pad-sized sort.
+
+    The coarsen + masked-exit tail is shared with the fused body, so
+    phase transitions cannot drift between engines.
+    """
+    from cuvite_tpu.louvain.driver import _bucketed_call, _run_phase_loop
+
+    wdt = w.dtype
+    sentinel = int(np.iinfo(np.int32).max)
+    call = _bucketed_call(nv_pad, sentinel, accum_dtype)
+    lower = jnp.asarray(-1.0, dtype=wdt)
+    th = jnp.asarray(threshold, dtype=wdt)
+
+    def one(bk, hv, sl, pm, s, ww, c):
+        vdeg = seg.segment_sum(ww, s, num_segments=nv_pad,
+                               sorted_ids=True)
+        comm0 = jnp.arange(nv_pad, dtype=jnp.int32)
+        # The trailing None is the heavy-kernel slot of the single-shard
+        # bucketed call convention (sorted heavy path).
+        extra = (bk, hv, sl, vdeg, c, pm, None)
+        return _run_phase_loop(extra, comm0, th, lower, call=call,
+                               max_iters=max_iters)
+
+    past, mod, iters, _ovf, (cq, cmoved, covf) = jax.vmap(one)(
+        buckets, heavy, self_loop, perm, src, w, constant)
+
+    return _phase_tail(
+        src, dst, w, comm_all, real_mask, prev_mod, active, threshold,
+        past, mod, iters, cq, cmoved, covf,
+        nv_pad=nv_pad, accum_dtype=accum_dtype, coalesce=coalesce)
+
+
+def _phase_tail(src, dst, w, comm_all, real_mask, prev_mod, active,
+                threshold, past, mod, iters, cq, cmoved, covf, *,
+                nv_pad, accum_dtype, coalesce):
+    """Shared phase epilogue (every batched engine): gain test, vmapped
+    device coarsening, masked per-row phase exit.  One definition so the
+    fused and bucketed phases retire rows and advance slabs
+    identically."""
+    wdt = w.dtype
     mod = mod.astype(wdt)
     gained = active & ((mod - prev_mod) > threshold)
 
@@ -139,6 +213,55 @@ def _phase_body(src, dst, w, comm_all, real_mask, prev_mod, active,
 # vertex-sharding axis the SPMD engines use for ONE big graph).
 BATCH_AXIS = "b"
 
+# Serving-coarse slab-class floors (engine='bucketed', ISSUE 10).  The
+# per-graph drivers shrink every coarse slab to its pow2 class
+# (coarsen/device.py::maybe_shrink_to_class); PR 9's batched driver kept
+# the PHASE-0 class for every phase, so coarse phases swept mostly
+# padding — at the serving class (4096, 16384) a 7-community coarse
+# graph still paid a [16384] 2-channel sort per iteration.  The
+# bucketed engine lifts the shrink to the batch: ONE notch, decided
+# after phase 0 from the (nc, ne2) scalars the per-phase sync already
+# carries — the whole batch drops to `_coarse_class` iff every active
+# row fits, else it stays put.  Binary decision -> at most two compiled
+# fused-phase programs per (class, B), and B=1 decides identically, so
+# served == solo bit-identity is preserved by construction.
+BATCH_COARSE_MIN_NV = 1024
+BATCH_COARSE_MIN_NE = 4096
+
+
+def _coarse_class(nv_pad: int, ne_pad: int) -> tuple:
+    """The one-notch serving-coarse class of a phase-0 slab class:
+    divide by 4 (one pow2 class per dimension is too timid — measured:
+    phase-0 coarsening collapses synth/R-MAT tenants far below it),
+    floored at the serving-coarse minima."""
+    return (max(nv_pad // 4, BATCH_COARSE_MIN_NV),
+            max(ne_pad // 4, BATCH_COARSE_MIN_NE))
+
+
+def _batched_coalesce_engine(nv_pad: int, adt: str) -> str:
+    """The coalesce engine of a batched phase at one slab class: the
+    env-resolved per-graph policy, with 'pallas' downgraded to its
+    bit-identical XLA twin — the Pallas seg-coalesce grid does not lift
+    over vmap (kernels/seg_coalesce.py).  One definition for the
+    phase-0 class and the serving-coarse class, so the downgrade rule
+    cannot drift between them."""
+    from cuvite_tpu.kernels.seg_coalesce import coalesce_engine
+
+    eng = coalesce_engine(nv_pad, "ds32" if adt == "ds32" else None)
+    return "xla" if eng == "pallas" else eng
+
+
+@functools.partial(jax.jit, static_argnames=("cnv", "cne"))
+def _shrink_batch(src, dst, w, real_mask, *, cnv: int, cne: int):
+    """Device-side batched slab-class shrink: per-row prefix slice +
+    padding-sentinel rewrite (coarse ids are dense and < nc <= cnv, so
+    only old sentinels move — the vmapped analog of
+    coarsen/device.py::shrink_slab) plus the real-mask prefix."""
+    s = src[:, :cne]
+    s = jnp.where(s >= cnv, jnp.asarray(cnv, s.dtype), s)
+    return s, dst[:, :cne], w[:, :cne], real_mask[:, :cnv]
+
+
 # Compiled batched-phase programs, keyed by (mesh devices, statics) —
 # the "one compile per (class, B)" cache.  jax.jit already caches per
 # callable+shapes; this table keeps the CALLABLE identity stable across
@@ -146,16 +269,24 @@ BATCH_AXIS = "b"
 _PHASE_CACHE: dict = {}
 
 
-def _get_batched_phase(mesh, nv_pad, accum_dtype, coalesce, max_iters):
+def _get_batched_phase(mesh, nv_pad, accum_dtype, coalesce, max_iters,
+                       engine: str = "fused", n_buckets: int = 0):
+    """The compiled batched-phase program for one ``(mesh, class
+    statics, engine)`` — ``engine='bucketed'`` adds the plan pytree
+    (``n_buckets`` triples + heavy/self_loop/perm) ahead of the slab
+    state; jax.jit still caches per shapes, so a bucketed program is one
+    compile per (class, B, bucket geometry)."""
     key = (
         None if mesh is None else tuple(d.id for d in mesh.devices.flat),
-        nv_pad, accum_dtype, coalesce, max_iters,
+        nv_pad, accum_dtype, coalesce, max_iters, engine, n_buckets,
     )
     fn = _PHASE_CACHE.get(key)
     if fn is not None:
         return fn
+    bucketed = engine == "bucketed"
     body = functools.partial(
-        _phase_body, nv_pad=nv_pad, accum_dtype=accum_dtype,
+        _bucketed_phase_body if bucketed else _phase_body,
+        nv_pad=nv_pad, accum_dtype=accum_dtype,
         coalesce=coalesce, max_iters=max_iters)
     if mesh is None:
         fn = jax.jit(body)
@@ -169,9 +300,14 @@ def _get_batched_phase(mesh, nv_pad, accum_dtype, coalesce, max_iters):
         # the batch axis, the threshold scalar replicates, and the body
         # contains NO collectives — each shard's while_loop paces only
         # its own rows (check_vma off: nothing is replicated to check).
+        if bucketed:
+            bspec = tuple((b, b, b) for _ in range(n_buckets))
+            in_specs = (bspec, (b, b, b)) + (b,) * 10 + (P(),)
+        else:
+            in_specs = (b,) * 8 + (P(),)
         fn = jax.jit(shard_map(
             body, mesh=mesh,
-            in_specs=(b, b, b, b, b, b, b, b, P()),
+            in_specs=in_specs,
             out_specs=(b,) * 14,
             check_vma=False,
         ))
@@ -211,6 +347,13 @@ class BatchResult:
     b_pad: int
     n_jobs: int
     slab_class: tuple      # (nv_pad, ne_pad)
+    # Engine telemetry (ISSUE 10): the engine each batch phase actually
+    # ran — ['bucketed', 'fused', ...] under engine='bucketed' (phase 0
+    # sort-free, coarse phases fused), all-'fused' otherwise.
+    phase_engines: list = dataclasses.field(default_factory=list)
+    # The serving-coarse class phases >= 1 ran at (engine='bucketed'
+    # whose post-phase-0 batch fit `_coarse_class`), else None.
+    coarse_class: tuple | None = None
 
     @property
     def pack_util(self) -> float:
@@ -268,10 +411,10 @@ def _batch_accum_name(batch: BatchedSlab) -> str:
 
 def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
                 max_phases: int = TERMINATION_PHASE_COUNT,
-                mesh="auto", tracer=None, verbose: bool = False
-                ) -> BatchResult:
-    """Cluster every row of a packed batch; one compile per (class, B),
-    one host sync per phase, one final label gather.
+                mesh="auto", tracer=None, verbose: bool = False,
+                engine: str = "fused", bucket_shape=None) -> BatchResult:
+    """Cluster every row of a packed batch; one compile per
+    (class, B, engine), one host sync per phase, one final label gather.
 
     Per-row semantics match the fused single-shard driver's plain
     schedule at a fixed ``threshold``: phases run until a row's gain
@@ -281,19 +424,30 @@ def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
     per-tenant wall is an AMORTIZED share, which is the serving-truth
     number (the batch really did cost one wall interval).
 
+    ``engine``: ``'fused'`` — every phase through the vmapped fused
+    loop; ``'bucketed'`` — phase 0 (the bulk of the per-row edge mass)
+    through the vmapped sort-free bucketed step over cross-graph-padded
+    plans built here at pack time (``batch_bucket_plans``); later
+    phases keep the fused loop.  ``bucket_shape`` pins the plan
+    geometry (``core.batch.BucketShape``) so many batches share one
+    compiled phase-0 program; None derives it from this batch.
+
     ``mesh``: ``'auto'`` shards the batch axis over the largest usable
     pow2 device count (:func:`make_batch_mesh`); ``None`` pins the
     single-device program; or pass an explicit 1-D ``Mesh`` over
     ``BATCH_AXIS``.  Sharding never changes per-row results — the
     program has no cross-row op — only which device runs which rows.
     """
-    from cuvite_tpu.kernels.seg_coalesce import coalesce_engine
+    from cuvite_tpu.core.batch import batch_bucket_plans
     from cuvite_tpu.louvain.driver import (
         LouvainResult,
         PhaseStats,
         _phase_sync,
     )
 
+    if engine not in BATCH_ENGINES:
+        raise ValueError(f"unknown batched engine {engine!r}; "
+                         f"use one of {BATCH_ENGINES}")
     if tracer is None:
         from cuvite_tpu.utils.trace import NullTracer
 
@@ -302,18 +456,26 @@ def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
     t0 = time.perf_counter()
     B = batch.b_pad
     nv_pad = batch.nv_pad
+    cur_nv, cur_ne = nv_pad, batch.ne_pad  # slab class of the NEXT phase
+    coarse_class = None
     wdt = np.dtype(np.float32)
     adt = _batch_accum_name(batch)
-    # The Pallas seg-coalesce grid does not lift over vmap; when the env
-    # opts a dense engine in, the batched path runs its XLA twin
-    # (bit-identical on the exactness domain, kernels/seg_coalesce.py).
-    eng = coalesce_engine(nv_pad, "ds32" if adt == "ds32" else None)
-    if eng == "pallas":
-        eng = "xla"
+    eng = _batched_coalesce_engine(nv_pad, adt)
     if mesh == "auto":
         mesh = make_batch_mesh(B)
     phase_fn = _get_batched_phase(mesh, nv_pad, adt, eng,
                                   MAX_TOTAL_ITERATIONS)
+    bplan = None
+    phase0_fn = None
+    if engine == "bucketed":
+        # Plans are built AT PACK TIME, before any device work — the
+        # plan-per-job trap (building them inside a dispatch loop) is
+        # what graftlint R015 guards against in serve/.
+        with tracer.stage("plan"):
+            bplan = batch_bucket_plans(batch, shape=bucket_shape)
+        phase0_fn = _get_batched_phase(
+            mesh, nv_pad, adt, eng, MAX_TOTAL_ITERATIONS,
+            engine="bucketed", n_buckets=len(bplan.buckets))
 
     def _place(x):
         if mesh is None:
@@ -332,9 +494,22 @@ def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
             np.arange(nv_pad, dtype=np.int32)[None, :],
             (B, nv_pad)).copy())
         prev_d = _place(np.full((B,), -1.0, dtype=wdt))
-    tracer.ledger_phase_begin()
-    tracer.track("slab", src_d, dst_d, w_d)
-    tracer.track("tables", rm_d, const_d)
+        plan_d = None
+        if bplan is not None:
+            # verts cast to the device vertex dtype; weights stay f32
+            # (the plan builder's stable-compile-key contract — see
+            # core/batch.py); every array shards on the batch axis like
+            # the slab.  plan_d is deliberately the ONLY reference to
+            # the device plan buffers, so dropping it after phase 0
+            # really frees them.
+            plan_d = (
+                tuple((_place(v.astype(np.int32)), _place(d), _place(ww))
+                      for v, d, ww in bplan.buckets),
+                tuple(_place(a) for a in bplan.heavy),
+                _place(bplan.self_loop),
+                _place(bplan.perm),
+            )
+            bplan = None  # the host-side plan copy is dead weight too
 
     active = np.asarray(batch.row_valid).copy()
 
@@ -344,19 +519,46 @@ def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
     tot_iters = np.zeros(B, dtype=np.int64)
     row_phases: list = [[] for _ in range(B)]
     row_conv: list = [[] for _ in range(B)]
+    phase_engines: list = []
     phase = 0
 
     while active.any() and phase < max_phases:
         t1 = time.perf_counter()
         active_at_start = active.copy()
+        # Phase 0 under engine='bucketed' runs the sort-free vmapped
+        # bucketed sweep over the pack-time plans; coarse phases (and
+        # every phase of engine='fused') run the fused loop.  The engine
+        # per phase is recorded for telemetry/bench provenance.
+        bucketed_phase = phase == 0 and phase0_fn is not None
+        phase_engines.append("bucketed" if bucketed_phase else "fused")
+        # HBM ledger: re-track the live set per phase, so the phase-0
+        # plan buffers leave the accounting once dropped and the slab
+        # bytes follow the serving-coarse shrink (the snapshot below
+        # must report what is actually resident, not the upload-time
+        # high-water).
+        tracer.ledger_phase_begin()
+        tracer.track("slab", src_d, dst_d, w_d)
+        tracer.track("tables", rm_d, const_d)
+        if plan_d is not None:
+            tracer.track("plans", *jax.tree_util.tree_leaves(plan_d))
         with tracer.stage("iterate"):
-            (src_d, dst_d, w_d, comm_all_d, rm_d, prev_d,
-             gained_d, mod_d, iters_d, nc_d, ne2_d,
-             cq_d, cmoved_d, covf_d) = phase_fn(
-                src_d, dst_d, w_d, comm_all_d, rm_d, prev_d,
-                active_at_start, const_d,
-                np.asarray(threshold, dtype=wdt),
-            )
+            if bucketed_phase:
+                (src_d, dst_d, w_d, comm_all_d, rm_d, prev_d,
+                 gained_d, mod_d, iters_d, nc_d, ne2_d,
+                 cq_d, cmoved_d, covf_d) = phase0_fn(
+                    *plan_d,
+                    src_d, dst_d, w_d, comm_all_d, rm_d, prev_d,
+                    active_at_start, const_d,
+                    np.asarray(threshold, dtype=wdt),
+                )
+            else:
+                (src_d, dst_d, w_d, comm_all_d, rm_d, prev_d,
+                 gained_d, mod_d, iters_d, nc_d, ne2_d,
+                 cq_d, cmoved_d, covf_d) = phase_fn(
+                    src_d, dst_d, w_d, comm_all_d, rm_d, prev_d,
+                    active_at_start, const_d,
+                    np.asarray(threshold, dtype=wdt),
+                )
             # THE one device->host sync of this phase: every per-row
             # scalar + the telemetry buffers in a single transfer.
             gained, (mod_h, iters_h, nc_h, ne2_h, cq_h, cmoved_h,
@@ -391,6 +593,26 @@ def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
             print(f"batched phase {phase}: active {int(active.sum())}/"
                   f"{batch.n_jobs}, iters {iters_h[:batch.n_jobs]}")
         tracer.ledger_snapshot(phase)
+        if bucketed_phase:
+            # The phase-0 plans are dead weight from here on (coarse
+            # phases run fused); drop the device refs so HBM frees.
+            plan_d = None
+            # One-notch coarse-class shrink (see _coarse_class): iff
+            # every row still clustering fits, the batch drops to the
+            # serving-coarse class — the decision reads only the (nc,
+            # ne2) scalars this phase's sync already fetched, and the
+            # fused phases then sweep/coalesce 4-16x less padding.
+            cnv, cne = _coarse_class(cur_nv, cur_ne)
+            if active.any() and (cnv, cne) != (cur_nv, cur_ne) \
+                    and int(nc_h[active].max()) <= cnv \
+                    and int(ne2_h[active].max()) <= cne:
+                src_d, dst_d, w_d, rm_d = _shrink_batch(
+                    src_d, dst_d, w_d, rm_d, cnv=cnv, cne=cne)
+                cur_nv, cur_ne = cnv, cne
+                coarse_class = (cnv, cne)
+                phase_fn = _get_batched_phase(
+                    mesh, cnv, adt, _batched_coalesce_engine(cnv, adt),
+                    MAX_TOTAL_ITERATIONS)
         phase += 1
 
     # THE final label gather: one O(B * nv_pad) transfer for the whole
@@ -413,20 +635,22 @@ def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
     return BatchResult(
         results=results, wall_s=wall, n_phases=phase, b_pad=B,
         n_jobs=batch.n_jobs, slab_class=batch.slab_class,
+        phase_engines=phase_engines, coarse_class=coarse_class,
     )
 
 
 def cluster_many(graphs, *, threshold: float = 1.0e-6,
                  max_phases: int = TERMINATION_PHASE_COUNT,
                  b_pad: int | None = None, slab_class: tuple | None = None,
-                 mesh="auto", tracer=None,
-                 verbose: bool = False) -> BatchResult:
+                 mesh="auto", tracer=None, verbose: bool = False,
+                 engine: str = "fused", bucket_shape=None) -> BatchResult:
     """Pack same-class graphs and run them as one batch (edgeless graphs
     are answered inline — every vertex its own community, Q = 0 — and
     never enter the packed batch, mirroring louvain_phases).  The
     returned ``results`` list covers EVERY input in order;
     ``n_jobs``/``pack_util``/``jobs_per_s`` describe only the PACKED
-    batch (inline-answered edgeless jobs cost no batch rows)."""
+    batch (inline-answered edgeless jobs cost no batch rows).
+    ``engine``/``bucket_shape``: see :func:`run_batched`."""
     from cuvite_tpu.louvain.driver import LouvainResult
 
     if tracer is None:
@@ -440,7 +664,8 @@ def cluster_many(graphs, *, threshold: float = 1.0e-6,
             batch = batch_slabs(packed, b_pad=b_pad,
                                 slab_class=slab_class)
         br = run_batched(batch, threshold=threshold, max_phases=max_phases,
-                         mesh=mesh, tracer=tracer, verbose=verbose)
+                         mesh=mesh, tracer=tracer, verbose=verbose,
+                         engine=engine, bucket_shape=bucket_shape)
     else:
         br = BatchResult(results=[], wall_s=0.0, n_phases=0, b_pad=0,
                          n_jobs=0, slab_class=(0, 0))

@@ -1542,6 +1542,8 @@ def louvain_many(
     mesh="auto",
     tracer=None,
     verbose: bool = False,
+    engine: str = "fused",
+    bucket_shape=None,
 ):
     """Cluster B same-slab-class graphs through ONE compiled per-phase
     program (ISSUE 9): the multi-tenant analog of :func:`louvain_phases`.
@@ -1549,9 +1551,19 @@ def louvain_many(
     Returns a ``louvain.batched.BatchResult`` whose ``results`` list
     holds one :class:`LouvainResult` per input graph, in order, each
     bit-identical to running this same entry with that graph alone
-    (B=1).  The batch axis pads to the ``core.batch.BATCH_SIZES``
-    ladder; per-graph phase exit is masking, not batch splitting, so
-    one compile serves every batch of the same ``(class, B)``.
+    (B=1, same engine).  The batch axis pads to the
+    ``core.batch.BATCH_SIZES`` ladder; per-graph phase exit is masking,
+    not batch splitting, so one compile serves every batch of the same
+    ``(class, B, engine)``.
+
+    ``engine`` (ISSUE 10): ``'fused'`` — every phase through the
+    vmapped fused sort-formulation loop; ``'bucketed'`` — phase 0
+    through the vmapped degree-bucketed sort-free sweep over
+    cross-graph-padded plans (``core.batch.batch_bucket_plans``), later
+    (small, coarse) phases fused; ``bucket_shape`` optionally pins the
+    plan geometry across batches (``core.batch.bucket_shape_for``).
+    The serving queue (cuvite_tpu/serve) selects the engine via
+    ``ServeConfig.engine``.
 
     Scope: fixed threshold / plain schedule / single shard per graph —
     the serving configuration.  Heterogeneous classes are the SERVING
@@ -1562,7 +1574,8 @@ def louvain_many(
 
     return cluster_many(graphs, threshold=threshold, max_phases=max_phases,
                         b_pad=b_pad, slab_class=slab_class, mesh=mesh,
-                        tracer=tracer, verbose=verbose)
+                        tracer=tracer, verbose=verbose, engine=engine,
+                        bucket_shape=bucket_shape)
 
 
 def louvain_phases(

@@ -26,6 +26,9 @@ import json
 import sys
 import time
 
+# numpy-only import chain: safe before the XLA_FLAGS setup in main().
+from cuvite_tpu.core.batch import BATCH_ENGINES
+
 
 def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -40,6 +43,14 @@ def _build_parser() -> argparse.ArgumentParser:
                        help="max wait of the oldest job before a partial "
                             "batch dispatches")
         q.add_argument("--threshold", type=float, default=1e-6)
+        q.add_argument("--engine", default="bucketed",
+                       choices=list(BATCH_ENGINES),
+                       help="batched per-phase engine: 'bucketed' "
+                            "(default — sort-free phase-0 sweep over "
+                            "pack-time bucket plans + serving-coarse "
+                            "later phases) or 'fused' (the all-phases "
+                            "sort-formulation loop); results are "
+                            "bit-identical either way")
         q.add_argument("--host-devices", type=int, default=8,
                        help="virtual CPU devices to shard the batch axis "
                             "over (ignored when jax already initialized "
@@ -92,7 +103,7 @@ def main(argv=None) -> int:
 
     server = LouvainServer(
         ServeConfig(b_max=args.b_max, linger_s=args.linger_ms / 1e3,
-                    threshold=args.threshold),
+                    threshold=args.threshold, engine=args.engine),
         tracer=tracer)
 
     t0 = time.perf_counter()

@@ -39,7 +39,12 @@ import dataclasses
 import itertools
 import time
 
-from cuvite_tpu.core.batch import BATCH_SIZES, batch_pad, slab_class_of
+from cuvite_tpu.core.batch import (
+    BATCH_ENGINES,
+    BATCH_SIZES,
+    batch_pad,
+    slab_class_of,
+)
 from cuvite_tpu.core.types import TERMINATION_PHASE_COUNT
 
 
@@ -48,17 +53,29 @@ class ServeConfig:
     """Queue knobs.  ``b_max`` should be a BATCH_SIZES rung (it is
     clamped to one): it caps both batch latency amortization and the
     compile-cache footprint per class.  ``linger_s`` bounds the extra
-    latency batching may add to any single job."""
+    latency batching may add to any single job.
+
+    ``engine`` (ISSUE 10) selects the batched driver's per-phase
+    engine: ``'bucketed'`` (the default — phase 0 through the vmapped
+    sort-free bucketed sweep over pack-time plans, coarse phases fused
+    at the serving-coarse class; the configuration every per-graph AND
+    batched benchmark shows is the fast one) or ``'fused'`` (PR 9's
+    all-phases sort-formulation loop).  Engine choice never changes
+    results — per-tenant labels/Q are bit-identical across engines."""
 
     b_max: int = 64
     linger_s: float = 0.05
     threshold: float = 1.0e-6
     max_phases: int = TERMINATION_PHASE_COUNT
     mesh: object = "auto"   # forwarded to run_batched
+    engine: str = "bucketed"
 
     def __post_init__(self) -> None:
         if self.b_max < 1:
             raise ValueError("b_max must be >= 1")
+        if self.engine not in BATCH_ENGINES:
+            raise ValueError(f"unknown serving engine {self.engine!r}; "
+                             f"use one of {BATCH_ENGINES}")
         # Round up to a ladder rung (full bins then pack with zero
         # padding), capped at the ladder top.
         self.b_max = min(batch_pad(self.b_max), BATCH_SIZES[-1])
@@ -72,9 +89,31 @@ class Job:
     t_submit: float
 
 
+# Queue-wait sample window (ISSUE 10): percentiles cover the most
+# recent WAIT_WINDOW dispatched jobs, so a long-lived server's latency
+# readout tracks CURRENT queue pressure instead of averaging over its
+# whole uptime (and the sample memory stays bounded).
+WAIT_WINDOW = 4096
+
+
+def percentile(samples, q: float) -> float:
+    """Nearest-rank percentile (q in [0, 100]) over a sequence — the
+    stdlib-only serving-latency estimator; 0.0 on no samples."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    rank = max(int(len(s) * q / 100.0 + 0.5), 1)
+    return float(s[min(rank, len(s)) - 1])
+
+
 @dataclasses.dataclass
 class ServeStats:
-    """Aggregate serving counters (monotone; read any time)."""
+    """Aggregate serving counters (monotone; read any time).  The
+    queue-wait percentiles (enqueue -> dispatch, driven by the server's
+    injectable clock) price the latency the batching discipline ADDS:
+    a p95 near ``linger_s`` means jobs mostly wait out the deadline
+    (rare classes / low traffic); a p95 near zero means bins fill and
+    dispatch full (the amortization regime)."""
 
     jobs_submitted: int = 0
     jobs_done: int = 0
@@ -84,6 +123,9 @@ class ServeStats:
     rows_padded: int = 0     # total batch rows incl. padding
     linger_dispatches: int = 0
     busy_s: float = 0.0      # wall spent inside the batched driver
+    # enqueue->dispatch waits of the last WAIT_WINDOW jobs (seconds).
+    wait_samples: collections.deque = dataclasses.field(
+        default_factory=lambda: collections.deque(maxlen=WAIT_WINDOW))
 
     @property
     def pack_util(self) -> float:
@@ -92,6 +134,14 @@ class ServeStats:
     @property
     def jobs_per_s(self) -> float:
         return self.jobs_done / max(self.busy_s, 1e-9)
+
+    @property
+    def wait_p50_s(self) -> float:
+        return percentile(self.wait_samples, 50.0)
+
+    @property
+    def wait_p95_s(self) -> float:
+        return percentile(self.wait_samples, 95.0)
 
     def to_dict(self) -> dict:
         return {
@@ -103,6 +153,8 @@ class ServeStats:
             "linger_dispatches": self.linger_dispatches,
             "busy_s": round(self.busy_s, 4),
             "jobs_per_s": round(self.jobs_per_s, 2),
+            "wait_p50_ms": round(self.wait_p50_s * 1e3, 3),
+            "wait_p95_ms": round(self.wait_p95_s * 1e3, 3),
         }
 
 
@@ -133,6 +185,13 @@ class LouvainServer:
         # _dispatch's isolation retry.
         self.failures: list = []
         self._bins: dict = collections.defaultdict(collections.deque)
+        # Sticky per-slab-class bucket geometry (engine='bucketed'):
+        # each dispatch pins the grow-only UNION of every geometry the
+        # class has served (core.batch.union_shapes), so per-batch
+        # degree-histogram jitter cannot churn compiled phase-0
+        # programs — the compile count per class converges (bounded by
+        # the class) instead of being one per distinct batch mix.
+        self._shapes: dict = {}
         self._ids = itertools.count()
 
     # -- intake -------------------------------------------------------------
@@ -183,9 +242,27 @@ class LouvainServer:
         # the rows that actually hit the device.
         n_real = sum(1 for j in jobs if j.graph.num_edges > 0)
         b_pad = batch_pad(n_real) if n_real else 0
+        shape = None
+        if self.config.engine == "bucketed" and n_real:
+            from cuvite_tpu.core.batch import bucket_shape_for, union_shapes
+
+            need = bucket_shape_for(
+                [j.graph for j in jobs if j.graph.num_edges > 0])
+            prev = self._shapes.get(cls)
+            shape = need if prev is None else union_shapes(prev, need)
+            # The sticky union is recorded only AFTER the batch
+            # completes (below): a poison job with an extreme degree
+            # histogram must not inflate the class's pinned geometry
+            # forever when it never produces a result.
+        # Queue-wait latency of THIS batch's jobs (enqueue -> dispatch
+        # decision), on the injectable clock: per-batch percentiles ride
+        # the pack span; the rolling aggregate feeds the serve summary.
+        waits = [max(now - j.t_submit, 0.0) for j in jobs]
         sid = self.tracer.begin_span(
             "pack", slab_class=list(cls), jobs=len(jobs), b_pad=b_pad,
-            trigger=trigger)
+            trigger=trigger, engine=self.config.engine,
+            wait_p50_s=round(percentile(waits, 50.0), 6),
+            wait_p95_s=round(percentile(waits, 95.0), 6))
         t0 = time.perf_counter()
         try:
             br = cluster_many(
@@ -193,6 +270,7 @@ class LouvainServer:
                 threshold=self.config.threshold,
                 max_phases=self.config.max_phases,
                 b_pad=b_pad or None, mesh=self.config.mesh,
+                engine=self.config.engine, bucket_shape=shape,
                 tracer=self.tracer)
         except Exception as e:  # noqa: BLE001 — isolation boundary
             busy = time.perf_counter() - t0
@@ -201,6 +279,9 @@ class LouvainServer:
             if len(jobs) == 1:
                 job = jobs[0]
                 self.stats.jobs_failed += 1
+                # A failed job still waited in the queue; its sample
+                # belongs in the latency percentiles like any other.
+                self.stats.wait_samples.append(waits[0])
                 self.failures.append((job.job_id, repr(e)))
                 self.tracer.event("tenant_error", job_id=job.job_id,
                                   slab_class=list(cls), error=repr(e))
@@ -211,6 +292,8 @@ class LouvainServer:
             return out
         busy = time.perf_counter() - t0
         self.tracer.end_span(sid, wall_s=busy, phases=br.n_phases)
+        if shape is not None:
+            self._shapes[cls] = shape
         if n_real:
             self.stats.batches += 1
             self.stats.rows_real += n_real
@@ -219,15 +302,16 @@ class LouvainServer:
         if trigger == "linger":
             self.stats.linger_dispatches += 1
         out = []
-        for job, res in zip(jobs, br.results):
+        for job, res, wait in zip(jobs, br.results, waits):
             self.stats.jobs_done += 1
+            self.stats.wait_samples.append(wait)
             self.tracer.event(
                 "tenant_result", job_id=job.job_id,
                 slab_class=list(cls), q=float(res.modularity),
                 phases=len(res.phases),
                 iterations=int(res.total_iterations),
                 communities=int(res.num_communities),
-                wait_s=round(max(now - job.t_submit, 0.0), 6))
+                wait_s=round(wait, 6))
             out.append((job.job_id, res))
         return out
 

@@ -30,6 +30,11 @@ import os
 import sys
 import time
 
+# Canonical batched-engine vocabulary (ISSUE 10); core.batch's import
+# chain is numpy-only, so this is safe before jax backend init (the
+# --batch path sets XLA_FLAGS first) and in perf_regress --self-check.
+from cuvite_tpu.core.batch import BATCH_ENGINES
+
 _T_PROC = time.perf_counter()  # budget accounting starts at import
 
 BASELINE_EDGES_PER_SEC_PER_CHIP = 1.0e9 / 64.0
@@ -156,6 +161,12 @@ def validate_record(rec: dict) -> list:
 # 9): B — the padded batch size the compiled program ran at; jobs_per_s
 # — real jobs completed per second of serving wall (packing, upload,
 # phases, unpack); pack_util — real rows / padded rows (the pack tax).
+# `engine` (ISSUE 10, always emitted by run_batch_bench) tags the
+# batched per-phase engine so fused and bucketed serving trajectories
+# never gate each other in tools/perf_regress.py; it stays OPTIONAL in
+# validation — pre-ISSUE-10 v4 batch records could only be fused, and
+# perf_regress's comparable() defaults the missing tag the same way, so
+# a historical round log must not retroactively fail --self-check.
 REQUIRED_BATCH_KEYS = ("B", "jobs_per_s", "pack_util")
 
 
@@ -178,6 +189,10 @@ def _validate_batch_block(batch) -> list:
     if not isinstance(pu, (int, float)) or not 0.0 < pu <= 1.0:
         problems.append(
             f"batch.pack_util must be a fraction in (0, 1], got {pu!r}")
+    if "engine" in batch and batch["engine"] not in BATCH_ENGINES:
+        problems.append(
+            f"batch.engine must be one of {BATCH_ENGINES}, "
+            f"got {batch['engine']!r}")
     return problems
 
 
@@ -411,6 +426,7 @@ def run_batch_bench(
     repeats: int = 3,
     budget_s: float = 420.0,
     platform: str = "cpu",
+    engine: str = "fused",
     t_start: float | None = None,
 ) -> dict:
     """Batched multi-tenant serving bench (ISSUE 9): K deterministic
@@ -418,15 +434,22 @@ def run_batch_bench(
     batched driver in chunks of ``B``, compile-guarded like the TEPS
     bench.  The record keeps the standard schema (metric = aggregate
     TEPS over all tenants) and adds the ``batch`` block: B, jobs/sec of
-    the best pass, pack_util, the slab class.  Compare records at the
-    SAME class and B only — perf_regress enforces that.
+    the best pass, pack_util, the slab class, the engine.  Compare
+    records at the SAME class, B and engine only — perf_regress
+    enforces that.
+
+    ``engine`` (ISSUE 10): 'fused' or 'bucketed' (see louvain_many).
+    Under 'bucketed' the bucket-plan geometry is pinned over the WHOLE
+    job set (core.batch.bucket_shape_for), so every chunk runs the one
+    phase-0 program the warm-up compiled — the (class, B, engine)
+    one-compile guarantee the guard asserts.
 
     ``n_jobs`` defaults to 3*B rounded up to a multiple of B (so every
-    pass runs whole batches and the warm-up covers the only (class, B)
-    program the timed passes use; a partial tail batch would compile a
-    second program inside the guard window).
+    pass runs whole batches and the warm-up covers the only
+    (class, B, engine) program set the timed passes use; a partial tail
+    batch would compile a second program inside the guard window).
     """
-    from cuvite_tpu.core.batch import slab_class_of
+    from cuvite_tpu.core.batch import bucket_shape_for, slab_class_of
     from cuvite_tpu.louvain.driver import louvain_many
     from cuvite_tpu.obs import NO_TRACE, CompileWatcher, FlightRecorder
     from cuvite_tpu.utils.trace import Tracer, rss_high_water_mb
@@ -436,6 +459,9 @@ def run_batch_bench(
     B = int(B)
     if B < 1:
         raise ValueError(f"--batch must be >= 1, got {B}")
+    if engine not in BATCH_ENGINES:
+        raise ValueError(f"--batch-engine must be one of {BATCH_ENGINES}, "
+                         f"got {engine!r}")
     if n_jobs is None:
         n_jobs = 3 * B
     n_jobs = max(B, ((n_jobs + B - 1) // B) * B)
@@ -445,8 +471,12 @@ def run_batch_bench(
     # little, so an --batch-edges near a pow2 boundary would otherwise
     # straddle two classes and break the pack (and the one-compile
     # guarantee the guard asserts).  Elementwise max is the class every
-    # graph fits.
+    # graph fits.  The bucketed engine additionally pins ONE bucket-plan
+    # geometry (the job-set union) for the same reason: per-chunk degree
+    # histograms vary, and an unpinned chunk would compile its own
+    # phase-0 program inside the guard window.
     cls = tuple(max(d) for d in zip(*(slab_class_of(g) for g in graphs)))
+    shape = bucket_shape_for(graphs) if engine == "bucketed" else None
     chunks = [graphs[i:i + B] for i in range(0, n_jobs, B)]
     frec = FlightRecorder(NO_TRACE, watch_compiles=False)
 
@@ -456,6 +486,7 @@ def run_batch_bench(
         batches = 0
         for chunk in chunks:
             br = louvain_many(chunk, b_pad=B, slab_class=cls,
+                              engine=engine, bucket_shape=shape,
                               tracer=tracer)
             results.extend(br.results)
             batches += 1
@@ -465,10 +496,18 @@ def run_batch_bench(
         return results, wall, traversed, batches
 
     # Warm-up: ONE chunk suffices — every chunk runs the same
-    # (class, B) program, so a full pass would just burn budget.
+    # (class, B, engine) program set: the slab class and bucket geometry
+    # are pinned above, and the serving-coarse shrink — the one
+    # DATA-DEPENDENT branch (it fires iff every active row's coarse
+    # graph fits class/4) — takes the same arm on every chunk of this
+    # homogeneous synth set with ~100x margin (tenants coarsen to ~7
+    # communities vs the 1024 floor).  If a pathological job set ever
+    # split the branch, a timed chunk would compile the other arm and
+    # the guard would abort loudly (rc=3) rather than mismeasure.
     warm_tr = Tracer(recorder=frec)
     with CompileWatcher(on_event=frec._on_compile):
-        louvain_many(chunks[0], b_pad=B, slab_class=cls, tracer=warm_tr)
+        louvain_many(chunks[0], b_pad=B, slab_class=cls, engine=engine,
+                     bucket_shape=shape, tracer=warm_tr)
 
     best = None
     guard = {"checked": True, "new_compiles": 0}
@@ -530,6 +569,7 @@ def run_batch_bench(
             "batches": int(batches),
             "class": list(cls),
             "edges_each": int(edges),
+            "engine": engine,
         },
     }
     return rec
@@ -565,6 +605,15 @@ def _build_parser() -> argparse.ArgumentParser:
                         "batched driver in chunks of B; the record "
                         "carries the `batch` block (jobs_per_s, "
                         "pack_util)")
+    b.add_argument("--batch-engine", default=env.get("BENCH_BATCH_ENGINE",
+                                                     "fused"),
+                   choices=list(BATCH_ENGINES),
+                   help="batched per-phase engine (ISSUE 10): 'fused' "
+                        "(PR 9's sort-formulation loop, every phase) or "
+                        "'bucketed' (sort-free vmapped bucketed phase 0 "
+                        "+ serving-coarse fused phases); the record's "
+                        "batch.engine field keeps the trajectories "
+                        "apart in perf_regress")
     b.add_argument("--batch-jobs", type=int, default=None,
                    help="total jobs K (default 3*B, rounded up to a "
                         "multiple of B)")
@@ -595,7 +644,8 @@ def main(argv=None) -> int:
             return 2
         if args.engine != "auto":
             print(f"# --batch ignores --engine {args.engine!r}: the "
-                  "batched driver is its own engine", file=sys.stderr)
+                  "batched driver selects its per-phase engine via "
+                  "--batch-engine {fused,bucketed}", file=sys.stderr)
         # Before ANY jax import: the virtual-device split only takes
         # effect at backend init (louvain/batched.py explains why a CPU
         # batch without it serializes its sorts).
@@ -614,6 +664,7 @@ def main(argv=None) -> int:
                 B=args.batch, n_jobs=args.batch_jobs,
                 edges=args.batch_edges, repeats=args.repeats,
                 budget_s=args.budget, platform=platform,
+                engine=args.batch_engine,
             )
         except BenchCompileGuardError as e:
             print(f"# BENCH ABORTED: {e}", file=sys.stderr)

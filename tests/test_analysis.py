@@ -436,6 +436,39 @@ def serve_loop(queue, b_max):
 """,
         "cuvite_tpu/serve/fake_r014.py",
     ),
+    (
+        "R015",
+        """
+from cuvite_tpu.louvain.bucketed import BucketPlan
+
+def dispatch(jobs, nv_pad):
+    plans = []
+    for job in jobs:
+        # plan-per-job trap: O(E) gather matrices rebuilt per tenant
+        plans.append(BucketPlan.build(job.src, job.dst, job.w,
+                                      nv_local=nv_pad, base=0))
+    return plans
+""",
+        """
+from cuvite_tpu.core.batch import batch_bucket_plans, batch_slabs
+from cuvite_tpu.louvain.bucketed import BucketPlan
+
+def dispatch(jobs, nv_pad):
+    # planning at pack time: ONE call covers every row of the batch
+    batch = batch_slabs([j.graph for j in jobs])
+    return batch_bucket_plans(batch)
+
+def one_off(job, nv_pad):
+    # outside any dispatch loop: a single job's plan is fine
+    return BucketPlan.build(job.src, job.dst, job.w,
+                            nv_local=nv_pad, base=0)
+
+def justified(jobs, nv_pad):
+    for job in jobs:
+        yield BucketPlan.build(job.src, job.dst, job.w, nv_local=nv_pad, base=0)  # graftlint: disable=R015 — diagnostic path, not dispatch
+""",
+        "cuvite_tpu/serve/fake_r015.py",
+    ),
 ]
 
 RULE_IDS = [c[0] for c in RULE_CASES]

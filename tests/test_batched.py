@@ -204,3 +204,197 @@ def test_sharding_never_changes_results(jobs, batch_result):
 def test_explicit_b_pad_validhalf(jobs):
     with pytest.raises(ValueError, match="b_pad"):
         louvain_many(jobs, b_pad=2)  # 4 jobs cannot pack into 2 rows
+
+
+# ---------------------------------------------------------------------------
+# Batched BUCKETED engine (ISSUE 10): the sort-free phase-0 sweep over
+# cross-graph-padded plans, phases >= 1 fused at the serving-coarse
+# class.  Same trust properties as the fused engine, plus the plan
+# packing/geometry contracts.
+
+
+@pytest.fixture(scope="module")
+def bucketed_result(jobs):
+    """One warm batched-bucketed run shared by read-only assertions."""
+    louvain_many(jobs, engine="bucketed")  # eat compiles for the spies
+    return louvain_many(jobs, engine="bucketed")
+
+
+def test_bucketed_rejects_unknown_engine(jobs):
+    with pytest.raises(ValueError, match="engine"):
+        louvain_many(jobs, engine="sorted")
+
+
+def test_bucketed_bit_identical_to_b1(jobs, bucketed_result):
+    """THE serving contract, bucketed edition: every tenant of a B=4
+    bucketed batch equals its own B=1 bucketed run bit-for-bit, with
+    the batch mixing convergence lengths (masked exit, not split)."""
+    singles = [louvain_many([g], engine="bucketed").results[0]
+               for g in jobs]
+    phase_counts = {len(r.phases) for r in bucketed_result.results}
+    assert len(phase_counts) > 1, \
+        "fixture must mix convergence lengths to exercise masking"
+    for rb, r1 in zip(bucketed_result.results, singles):
+        assert r1.modularity == rb.modularity
+        assert np.array_equal(r1.communities, rb.communities)
+        assert r1.total_iterations == rb.total_iterations
+        assert len(r1.phases) == len(rb.phases)
+
+
+def test_bucketed_matches_pergraph_bucketed_driver(jobs, bucketed_result):
+    """Per-tenant LABELS are bit-identical to the per-graph bucketed
+    driver (louvain_phases engine='auto' -> bucketed): the batched
+    sweep runs the same _run_phase_loop over the same _bucketed_call.
+    Q agrees up to the in-loop-f32 vs precise-recompute gap."""
+    for g, rb in zip(jobs, bucketed_result.results):
+        ref = louvain_phases(g, verbose=False)
+        assert np.array_equal(ref.communities, rb.communities)
+        assert abs(ref.modularity - rb.modularity) < 5e-5
+        assert ref.num_communities == rb.num_communities
+
+
+def test_bucketed_matches_fused_engine(jobs, bucketed_result):
+    """Engine choice never changes results: fused and bucketed batches
+    agree bit-for-bit per tenant."""
+    fused = louvain_many(jobs, engine="fused")
+    for rb, rf in zip(bucketed_result.results, fused.results):
+        assert rb.modularity == rf.modularity
+        assert np.array_equal(rb.communities, rf.communities)
+        assert rb.total_iterations == rf.total_iterations
+
+
+def test_bucketed_phase_engine_telemetry(bucketed_result):
+    """Phase 0 records the bucketed engine, coarse phases the fused
+    loop, and the one-notch serving-coarse shrink is reported."""
+    eng = bucketed_result.phase_engines
+    assert eng[0] == "bucketed"
+    assert all(e == "fused" for e in eng[1:]) and len(eng) >= 2
+    assert bucketed_result.coarse_class == (1024, 4096)
+    fused = louvain_many([generate_rmat(8, edge_factor=8, seed=1)])
+    assert all(e == "fused" for e in fused.phase_engines)
+    assert fused.coarse_class is None
+
+
+def test_batch_bucket_plans_geometry(jobs):
+    """Cross-graph padding: kept widths = union over the batch, row
+    counts = pow2 batch max, [B, rows, width] stacking, absent rows
+    flag-masked with the verts == nv_pad sentinel."""
+    from cuvite_tpu.core.batch import (
+        batch_bucket_plans,
+        batch_slabs,
+        bucket_shape_for,
+    )
+
+    batch = batch_slabs(jobs)
+    plan = batch_bucket_plans(batch)
+    nv = batch.nv_pad
+    # The slab-derived geometry equals the degree-derived one (the
+    # shape-pinning path must agree with the packing path).
+    assert plan.shape == bucket_shape_for(jobs)
+    assert list(plan.shape.widths) == sorted(plan.shape.widths)
+    for (verts, dmat, wmat), width, rows in zip(
+            plan.buckets, plan.shape.widths, plan.shape.rows):
+        assert rows & (rows - 1) == 0, "row counts must be pow2"
+        assert verts.shape == (batch.b_pad, rows)
+        assert dmat.shape == wmat.shape == (batch.b_pad, rows, width)
+        assert wmat.dtype == np.float32  # stable-compile-key contract
+        # Per-row padding tails are pure sentinel rows.
+        for i in range(batch.b_pad):
+            pad_rows = verts[i] >= nv
+            assert (wmat[i][pad_rows] == 0).all()
+    assert plan.perm.shape == (batch.b_pad, nv)
+    assert plan.self_loop.shape == (batch.b_pad, nv)
+
+
+def test_bucketed_pad_rows_carry_empty_plans(jobs):
+    """A 3-job batch pads to the 4-rung: the pad row's plan is pure
+    sentinel (it traces, costs two masked sweeps, and leaks no NaN into
+    real tenants, which stay bit-identical to their solo runs)."""
+    from cuvite_tpu.core.batch import batch_bucket_plans, batch_slabs
+
+    batch = batch_slabs(jobs[:3])
+    assert batch.b_pad == 4 and not batch.row_valid[3]
+    plan = batch_bucket_plans(batch)
+    for verts, dmat, wmat in plan.buckets:
+        assert (verts[3] == batch.nv_pad).all()
+        assert (wmat[3] == 0).all()
+    hs, _hd, hw = plan.heavy
+    assert (hs[3] == batch.nv_pad).all() and (hw[3] == 0).all()
+    assert (plan.self_loop[3] == 0).all()
+
+    br = louvain_many(jobs[:3], engine="bucketed")
+    for g, rb in zip(jobs[:3], br.results):
+        assert np.isfinite(rb.modularity)
+        assert all(np.isfinite(row.q) for pc in rb.convergence
+                   for row in pc.rows)
+        solo = louvain_many([g], engine="bucketed").results[0]
+        assert solo.modularity == rb.modularity
+        assert np.array_equal(solo.communities, rb.communities)
+
+
+def test_bucket_shape_pin_and_refusal(jobs):
+    """A pinned geometry must cover the batch: pinning the job-set
+    union works (and keeps results bit-identical); a too-small shape
+    refuses loudly instead of truncating plans."""
+    from cuvite_tpu.core.batch import (
+        BucketShape,
+        batch_bucket_plans,
+        batch_slabs,
+        bucket_shape_for,
+    )
+
+    shape = bucket_shape_for(jobs)
+    br = louvain_many(jobs, engine="bucketed", bucket_shape=shape)
+    solo = louvain_many([jobs[0]], engine="bucketed").results[0]
+    assert solo.modularity == br.results[0].modularity
+    assert np.array_equal(solo.communities, br.results[0].communities)
+    tiny = BucketShape(widths=(8,), rows=(1,), heavy_pad=8)
+    with pytest.raises(ValueError, match="does not fit"):
+        batch_bucket_plans(batch_slabs(jobs), shape=tiny)
+
+
+def test_bucketed_zero_fresh_compiles_on_second_batch(jobs,
+                                                      bucketed_result):
+    """One compile per (class, B, engine): a second bucketed batch of
+    DIFFERENT graphs at the same B with the job-set-union geometry
+    pinned (the bench's discipline) traces nothing new — including the
+    serving-coarse fused phases."""
+    from cuvite_tpu.core.batch import bucket_shape_for
+
+    fresh = [generate_rmat(8, edge_factor=8, seed=s) for s in (11, 12)]
+    fresh += [synthesize_graph(2048, seed=many_seed(7, k)) for k in (2, 3)]
+    shape = bucket_shape_for(list(jobs) + fresh)
+    louvain_many(jobs, engine="bucketed", bucket_shape=shape)  # warm pin
+    with CompileWatcher() as watch:
+        br = louvain_many(fresh, engine="bucketed", bucket_shape=shape)
+    assert watch.compiles == [], \
+        f"second (class, B, engine) batch recompiled: {watch.compiles}"
+    assert len(br.results) == 4
+
+
+def test_one_device_sync_per_phase_bucketed(jobs, bucketed_result,
+                                            monkeypatch):
+    """The bucketed batched path keeps the sync discipline: one
+    driver._phase_sync per phase (bucketed phase 0 included) plus
+    exactly one final label gather."""
+    orig_get = jax.device_get
+    gets = []
+
+    def spy(x):
+        gets.append(x)
+        return orig_get(x)
+
+    monkeypatch.setattr(jax, "device_get", spy)
+    br = louvain_many(jobs, engine="bucketed")
+    assert len(gets) == br.n_phases + 1, \
+        f"{len(gets)} device_get calls for {br.n_phases} batch phases " \
+        "(want one per phase + the final label gather)"
+
+
+def test_bucketed_sharding_never_changes_results(jobs, bucketed_result):
+    """The batch-axis mesh split changes which device runs which rows,
+    never what a bucketed row computes."""
+    unsharded = louvain_many(jobs, engine="bucketed", mesh=None)
+    for ra, rb in zip(bucketed_result.results, unsharded.results):
+        assert ra.modularity == rb.modularity
+        assert np.array_equal(ra.communities, rb.communities)

@@ -114,12 +114,95 @@ def test_pack_span_and_tenant_events(small_graphs):
     assert pk["begin"]["attrs"]["jobs"] == 2
     assert pk["begin"]["attrs"]["b_pad"] == 2
     assert pk["begin"]["attrs"]["trigger"] == "full"
+    # ISSUE 10: the span says which engine packed the batch and what
+    # the batch's jobs waited (per-batch percentiles).
+    assert pk["begin"]["attrs"]["engine"] == "bucketed"
+    assert {"wait_p50_s", "wait_p95_s"} <= set(pk["begin"]["attrs"])
     assert pk["end"] is not None and "wall_s" in pk["end"]["attrs"]
     tenants = [r for r in sink.records
                if r.get("t") == "event" and r.get("name") == "tenant_result"]
     assert len(tenants) == 2
     assert {"job_id", "q", "phases", "communities",
             "wait_s"} <= set(tenants[0]["attrs"])
+
+
+def test_queue_wait_percentiles(small_graphs):
+    """Queue-wait latency (enqueue -> dispatch) on the injected clock:
+    p50/p95 over the dispatched jobs, surfaced in the serve summary."""
+    clock = FakeClock()
+    srv = LouvainServer(ServeConfig(b_max=4, linger_s=0.5), clock=clock)
+    srv.submit(small_graphs[0])   # will wait 0.7 s
+    clock.t += 0.4
+    srv.submit(small_graphs[1])   # will wait 0.3 s
+    clock.t += 0.3                # oldest passes the 0.5 s deadline
+    done = srv.step()
+    assert len(done) == 2 and srv.stats.linger_dispatches == 1
+    waits = sorted(srv.stats.wait_samples)
+    assert waits == pytest.approx([0.3, 0.7])
+    assert srv.stats.wait_p50_s == pytest.approx(0.3)
+    assert srv.stats.wait_p95_s == pytest.approx(0.7)
+    summary = srv.stats.to_dict()
+    assert summary["wait_p50_ms"] == pytest.approx(300.0)
+    assert summary["wait_p95_ms"] == pytest.approx(700.0)
+
+
+def test_wait_percentile_estimator():
+    from cuvite_tpu.serve.queue import percentile
+
+    assert percentile([], 95.0) == 0.0
+    assert percentile([5.0], 50.0) == 5.0
+    xs = list(range(1, 101))
+    assert percentile(xs, 50.0) == 50
+    assert percentile(xs, 95.0) == 95
+    assert percentile(xs, 100.0) == 100
+
+
+def test_serve_sticky_bucket_geometry(small_graphs):
+    """The queue pins each class's bucket geometry to the grow-only
+    union of everything it has served: after two batches of different
+    degree mixes, a third batch whose needs fit the union compiles
+    NOTHING (no per-batch geometry churn in the serving hot path)."""
+    from cuvite_tpu.core.batch import bucket_shape_for
+    from cuvite_tpu.obs import CompileWatcher
+
+    rmats = [generate_rmat(8, edge_factor=8, seed=s) for s in (21, 22)]
+    clock = FakeClock()
+    srv = LouvainServer(ServeConfig(b_max=2, linger_s=0.0), clock=clock)
+    for g in small_graphs[:2]:
+        srv.submit(g)
+    srv.step()
+    cls = slab_class_of(small_graphs[0])
+    first = srv._shapes[cls]
+    for g in rmats:          # same class, different degree histogram
+        srv.submit(g)
+    srv.step()
+    grown = srv._shapes[cls]
+    assert grown.fits(first), "sticky shape must only grow"
+    assert grown.fits(bucket_shape_for(rmats))
+    # A repeat mix inside the union reuses both compiled programs.
+    for g in [small_graphs[2], rmats[0]]:
+        srv.submit(g)
+    with CompileWatcher() as watch:
+        done = srv.step()
+    assert len(done) == 2
+    assert watch.compiles == [], \
+        f"geometry inside the sticky union recompiled: {watch.compiles}"
+
+
+def test_serve_engine_selection(small_graphs):
+    """ServeConfig.engine reaches the batched driver (default
+    'bucketed'; 'fused' keeps PR 9's program) and bogus engines refuse
+    at config time, not mid-dispatch."""
+    with pytest.raises(ValueError, match="engine"):
+        ServeConfig(engine="sorted")
+    srv = LouvainServer(ServeConfig(b_max=2, linger_s=0.0,
+                                    engine="fused"), clock=FakeClock())
+    ids = [srv.submit(g) for g in small_graphs[:2]]
+    done = dict(srv.drain())
+    for jid, g in zip(ids, small_graphs):
+        direct = louvain_many([g], engine="fused").results[0]
+        assert done[jid].modularity == direct.modularity
+        assert np.array_equal(done[jid].communities, direct.communities)
 
 
 def test_poison_job_isolated_not_batch_fatal(small_graphs):
@@ -263,6 +346,16 @@ def test_batch_block_validation_rejects_malformed(batch_record):
     assert any("jobs_per_s" in p for p in validate_record(rec))
     rec["batch"] = dict(batch_record["batch"], B="two")
     assert any("batch.B" in p for p in validate_record(rec))
+    # ISSUE 10: a PRESENT engine tag must be a known batched engine; a
+    # MISSING one is tolerated (pre-ISSUE-10 v4 batch records could
+    # only be fused, and perf_regress defaults them exactly so — a
+    # historical round log must not retroactively fail --self-check).
+    rec["batch"] = dict(batch_record["batch"], engine="sorted")
+    assert any("batch.engine" in p for p in validate_record(rec))
+    noeng = dict(batch_record["batch"])
+    del noeng["engine"]
+    rec["batch"] = noeng
+    assert validate_record(rec) == []
 
 
 def _round_log(path, rec, n=97):
@@ -305,3 +398,31 @@ def test_perf_regress_ignores_other_batch_configs(tmp_path, batch_record):
     out = _gate(tmp_path, batch_record, peer)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "0 comparable" in out.stdout
+
+
+def test_perf_regress_separates_batch_engines(tmp_path, batch_record):
+    """ISSUE 10: fused and bucketed serving trajectories never gate
+    each other — a bucketed record several-x above the fused one must
+    not flag a fresh fused record (same B, same class)."""
+    peer = json.loads(json.dumps(batch_record))
+    peer["batch"]["engine"] = "bucketed"
+    peer["batch"]["jobs_per_s"] = \
+        batch_record["batch"]["jobs_per_s"] * 100
+    out = _gate(tmp_path, batch_record, peer)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 comparable" in out.stdout
+
+
+def test_perf_regress_legacy_batch_records_gate_as_fused(tmp_path,
+                                                         batch_record):
+    """A pre-ISSUE-10 trajectory batch record (no engine tag) ran the
+    fused loop; it must keep gating fresh FUSED records — a missing tag
+    must not silently reset the fused serving baseline."""
+    peer = json.loads(json.dumps(batch_record))
+    del peer["batch"]["engine"]
+    del peer["schema"]   # legacy rounds predate strict v4 validation
+    peer["batch"]["jobs_per_s"] = \
+        batch_record["batch"]["jobs_per_s"] * 2
+    out = _gate(tmp_path, batch_record, peer)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "batch jobs_per_s" in out.stderr

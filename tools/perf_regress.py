@@ -110,7 +110,11 @@ def comparable(fresh: dict, rec: dict) -> bool:
         return False
     # Batched serving records (ISSUE 9) gate like-for-like only: same
     # padded batch size AND same slab class — jobs/sec at B=64 on the
-    # (4096, 16384) class says nothing about B=8 or a bigger class.
+    # (4096, 16384) class says nothing about B=8 or a bigger class —
+    # AND same batched engine (ISSUE 10): the bucketed trajectory runs
+    # several-x above the fused one by design, so letting them gate
+    # each other would either mask a bucketed regression behind the
+    # fused floor or flag every fused record against the bucketed best.
     fb, rb = fresh.get("batch"), rec.get("batch")
     if (fb is None) != (rb is None):
         return False
@@ -118,6 +122,13 @@ def comparable(fresh: dict, rec: dict) -> bool:
         if fb.get("B") != rb.get("B"):
             return False
         if fb.get("class") != rb.get("class"):
+            return False
+        # Pre-ISSUE-10 batch records carry no engine tag, but every one
+        # of them ran the fused loop (the only engine that existed) —
+        # defaulting the missing side keeps the fused trajectory gating
+        # fresh fused records instead of silently resetting to "no
+        # comparable peers".
+        if (fb.get("engine") or "fused") != (rb.get("engine") or "fused"):
             return False
     return True
 

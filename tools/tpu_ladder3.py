@@ -251,31 +251,35 @@ def stage_e():
 
 
 def stage_g():
-    """Batched multi-tenant serving bench at B in {1, 8, 64} (ISSUE 9):
-    jobs/sec + pack_util through the batched driver on-chip, staged
-    next to the seg-coalesce A/B so the first platform=tpu record can
-    cover both.  On a TPU slice the batch axis shards over the chips
-    (louvain/batched.py BATCH_AXIS); each B writes its own JSON the
-    moment it exists."""
+    """Batched multi-tenant serving bench at B in {1, 8, 64} (ISSUE 9),
+    A/B'd fused-vs-bucketed (ISSUE 10): jobs/sec + pack_util through
+    the batched driver on-chip, staged next to the seg-coalesce A/B so
+    the first platform=tpu record can cover both.  On a TPU slice the
+    batch axis shards over the chips (louvain/batched.py BATCH_AXIS);
+    each (B, engine) cell writes its own JSON the moment it exists, so
+    a timeout mid-sweep loses nothing already measured."""
     for b in (1, 8, 64):
-        out_path = os.path.join(REPO, f"tools/bench_tpu_batch_b{b}.json")
-        t0 = time.perf_counter()
-        try:
-            out = subprocess.run(
-                [sys.executable, "-m", "cuvite_tpu.workloads", "bench",
-                 "--batch", str(b), "--repeats", "3",
-                 "--out", out_path],
-                capture_output=True, text=True, timeout=1800, cwd=REPO)
-        except subprocess.TimeoutExpired:
-            log(f"G: batch B={b} TIMEOUT (1800s)")
-            continue
-        last = out.stdout.strip().splitlines()
-        log(f"G: batch B={b} rc={out.returncode} "
-            f"wall={time.perf_counter()-t0:.0f}s "
-            f"json={last[-1] if last else out.stderr[-200:]}")
-        if out.returncode == 3:
-            log("G: compile guard tripped — a timed batch recompiled; "
-                "no JSON by design")
+        for eng in ("fused", "bucketed"):
+            out_path = os.path.join(
+                REPO, f"tools/bench_tpu_batch_{eng}_b{b}.json")
+            t0 = time.perf_counter()
+            try:
+                out = subprocess.run(
+                    [sys.executable, "-m", "cuvite_tpu.workloads",
+                     "bench", "--batch", str(b), "--batch-engine", eng,
+                     "--repeats", "3", "--out", out_path],
+                    capture_output=True, text=True, timeout=1800,
+                    cwd=REPO)
+            except subprocess.TimeoutExpired:
+                log(f"G: batch B={b} engine={eng} TIMEOUT (1800s)")
+                continue
+            last = out.stdout.strip().splitlines()
+            log(f"G: batch B={b} engine={eng} rc={out.returncode} "
+                f"wall={time.perf_counter()-t0:.0f}s "
+                f"json={last[-1] if last else out.stderr[-200:]}")
+            if out.returncode == 3:
+                log("G: compile guard tripped — a timed batch "
+                    "recompiled; no JSON by design")
 
 
 def main():
