@@ -1,4 +1,4 @@
-"""graftlint rule set R001..R015 (see ANALYSIS.md for the catalogue).
+"""graftlint rule set R001..R016 (see ANALYSIS.md for the catalogue).
 
 Each rule targets a hazard class this codebase has actually hit (or is
 one refactor away from hitting): host syncs inside jitted code, jit
@@ -12,8 +12,9 @@ block shapes not derived from the static width-ladder constants, and
 bench timing windows that close without forcing device completion,
 full-slab sorts in coarsen/kernels outside the sanctioned coalesce
 fallback chokepoint, compile/upload-per-job traps in serving queue
-loops, and bucket-plan construction inside serve/ dispatch loops
-(planning belongs at pack time).
+loops, bucket-plan construction inside serve/ dispatch loops (planning
+belongs at pack time), and direct wall-clock reads in serve/ outside
+the injectable-clock plumbing (untestable deadlines).
 
 Rules are heuristic by design: they trade completeness for a near-zero
 false-positive rate on idiomatic code, and every remaining intentional
@@ -1076,3 +1077,53 @@ class ServeLoopPlanTrap(Rule):
                 "covering every row in one host pass; hoist the "
                 "plan construction out of the loop, or justify "
                 "with an inline '# graftlint: disable=R015'")
+
+
+# ---------------------------------------------------------------------------
+# R016: direct wall-clock reads in serve/ outside the injectable-clock
+# plumbing (ISSUE 11).  Every deadline in the serving layer — linger,
+# job deadline shedding, admission retry_after_s, retry backoff — runs
+# on an injected ``clock`` so tests can drive it without sleeping.  A
+# ``time.monotonic()`` / ``time.time()`` call added directly in serve/
+# re-introduces the untestable-deadline trap: the behavior it gates can
+# only be exercised by actually sleeping through it (slow, flaky), and
+# a fake-clock test silently no longer covers the path.  The ONE
+# sanctioned wall-clock site is serve/clock.py (the plumbing the
+# injectable defaults come from); ``time.perf_counter()`` stays
+# allowlisted everywhere — busy-window timing measures real elapsed
+# work and is never compared against an injectable deadline.
+
+_SERVE_CLOCK_MODULE = "cuvite_tpu/serve/clock.py"
+# time.monotonic / time.time by dotted name, plus the bare from-import
+# spelling of monotonic (a bare `time()` call is left out: it is far
+# more likely to be a local callable than the stdlib clock).
+_WALL_CLOCK_CALLS = {"time.monotonic", "time.time", "monotonic"}
+
+
+@register
+class ServeWallClockOutsidePlumbing(Rule):
+    id = "R016"
+    severity = "high"
+    title = "direct wall-clock read in serve/ outside the " \
+            "injectable-clock plumbing"
+
+    def check(self, sf):
+        if not sf.rel.startswith(_SERVE_SCOPE) \
+                or sf.rel == _SERVE_CLOCK_MODULE:
+            return
+        for node in sf.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            if fname in _WALL_CLOCK_CALLS:
+                yield self.finding(
+                    sf, node,
+                    f"{fname}() read directly in a serve/ module: "
+                    "serving deadlines must run on the INJECTABLE "
+                    "clock (serve/clock.py plumbing, threaded as the "
+                    "clock=/sleep= parameters) or they become "
+                    "untestable without real sleeps; call the injected "
+                    "clock instead (time.perf_counter busy-timing is "
+                    "allowlisted, and a reference like "
+                    "clock=time.monotonic as a DEFAULT is fine — only "
+                    "direct calls are flagged)")

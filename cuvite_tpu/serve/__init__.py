@@ -1,15 +1,32 @@
-"""cuvite_tpu.serve — the multi-tenant serving layer (ISSUE 9).
+"""cuvite_tpu.serve — the fault-tolerant multi-tenant serving layer.
 
 A slab-class serving queue in front of the batched driver
 (louvain/batched.py): incoming jobs bin by their pow2 slab class
-(core/batch.py::slab_class_of), pack into batches up to ``b_max`` with
-a max-linger deadline, run through ONE compiled per-phase program per
-``(class, B)``, and unpack into per-tenant ``LouvainResult``s.
+(core/batch.py::slab_class_of) with per-tenant fairness sub-queues,
+pack into batches up to ``b_max`` with a max-linger deadline, run
+through ONE compiled per-phase program per ``(class, B)``, and unpack
+into per-tenant ``LouvainResult``s.
+
+Around that core (ISSUE 11): SLO-projected admission control with
+structured ``retry_after_s`` rejections (admission.py), deadline
+shedding, deterministic fault injection with bounded
+exponential-backoff retry (faults.py), an async socket daemon with
+graceful SIGTERM drain (daemon.py), and an open-loop saturation load
+generator (loadgen.py).  Every deadline runs on the injectable clock
+(clock.py; graftlint R016).
 
     python -m cuvite_tpu.serve demo --jobs 64 --b-max 16
     python -m cuvite_tpu.serve cluster-many a.vite b.vite ...
+    python -m cuvite_tpu.serve daemon --socket /tmp/cuvite.sock
 """
 
+from cuvite_tpu.serve.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    AdmissionReject,
+)
+from cuvite_tpu.serve.daemon import ServeDaemon
+from cuvite_tpu.serve.faults import FaultPlan, InjectedFault
 from cuvite_tpu.serve.queue import (
     Job,
     LouvainServer,
@@ -17,4 +34,8 @@ from cuvite_tpu.serve.queue import (
     ServeStats,
 )
 
-__all__ = ["Job", "LouvainServer", "ServeConfig", "ServeStats"]
+__all__ = [
+    "AdmissionConfig", "AdmissionController", "AdmissionReject",
+    "FaultPlan", "InjectedFault", "Job", "LouvainServer", "ServeConfig",
+    "ServeDaemon", "ServeStats",
+]

@@ -6,10 +6,20 @@
     # cluster many Vite files as one multi-tenant workload
     python -m cuvite_tpu.serve cluster-many a.vite b.vite --output
 
-Both paths run the slab-class batching queue (serve/queue.py) over the
-batched driver: jobs bin by class, pack to ``--b-max`` with a
-``--linger-ms`` deadline, and per-tenant results stream out as JSON
-lines, followed by one summary line (jobs/sec, pack_util, batches).
+    # the async daemon: socket intake, admission control, graceful drain
+    python -m cuvite_tpu.serve daemon --socket /tmp/cuvite.sock \
+        --wait-slo-ms 500 --fault-plan "device:transient:n=1"
+
+All paths run the slab-class batching queue (serve/queue.py) over the
+batched driver: jobs bin by class with per-tenant fairness, pack to
+``--b-max`` with a ``--linger-ms`` deadline, and per-tenant results
+stream out as JSON lines, followed by one summary line.  The daemon
+adds newline-delimited-JSON socket intake (serve/daemon.py documents
+the wire protocol), SLO-projected admission control
+(``--wait-slo-ms``), deadline shedding, deterministic fault injection
+(``--fault-plan`` / ``CUVITE_FAULT_PLAN``) and a graceful drain on
+SIGTERM/SIGINT: intake closes, queued bins flush, the final stats go
+out as a ``serve_summary`` event, and the process exits 0.
 
 On CPU the batch axis shards over virtual host devices
 (``--host-devices``, default 8): XLA:CPU executes a batched sort
@@ -60,6 +70,19 @@ def _build_parser() -> argparse.ArgumentParser:
                             "tenant_result events; OBSERVABILITY.md)")
         q.add_argument("--json", action="store_true",
                        help="per-tenant JSON result lines")
+        q.add_argument("--wait-slo-ms", type=float, default=None,
+                       help="enable admission control: reject (with "
+                            "retry_after_s) when a class's projected "
+                            "queue wait breaches this SLO")
+        q.add_argument("--fault-plan", default=None,
+                       metavar="SITE:KIND:PARAMS[;...]",
+                       help="deterministic fault injection plan "
+                            "(serve/faults.py grammar; default: the "
+                            "CUVITE_FAULT_PLAN env var)")
+        q.add_argument("--max-retries", type=int, default=3,
+                       help="transient-fault retry budget per dispatch")
+        q.add_argument("--retry-base-ms", type=float, default=50.0,
+                       help="retry backoff base (doubles per attempt)")
 
     d = sub.add_parser("demo", help="synthetic multi-tenant load")
     common(d)
@@ -75,7 +98,35 @@ def _build_parser() -> argparse.ArgumentParser:
     c.add_argument("--bits64", action="store_true")
     c.add_argument("--output", action="store_true",
                    help="write <file>.communities per input")
+
+    dm = sub.add_parser("daemon",
+                        help="async serving daemon (socket intake, "
+                             "graceful SIGTERM drain)")
+    common(dm)
+    dm.add_argument("--socket", metavar="PATH",
+                    help="unix-domain socket path for intake")
+    dm.add_argument("--port", type=int, default=None,
+                    help="TCP port for intake (0 = ephemeral; mutually "
+                         "exclusive with --socket)")
+    dm.add_argument("--host", default="127.0.0.1")
     return p
+
+
+def _make_server(args):
+    from cuvite_tpu.serve.admission import AdmissionConfig
+    from cuvite_tpu.serve.faults import FaultPlan
+    from cuvite_tpu.serve.queue import LouvainServer, ServeConfig
+
+    admission = (AdmissionConfig(wait_slo_s=args.wait_slo_ms / 1e3)
+                 if args.wait_slo_ms is not None else None)
+    faults = (FaultPlan.parse(args.fault_plan)
+              if args.fault_plan is not None else FaultPlan.from_env())
+    config = ServeConfig(
+        b_max=args.b_max, linger_s=args.linger_ms / 1e3,
+        threshold=args.threshold, engine=args.engine,
+        admission=admission, max_retries=args.max_retries,
+        retry_base_s=args.retry_base_ms / 1e3)
+    return config, faults, LouvainServer
 
 
 def main(argv=None) -> int:
@@ -84,7 +135,6 @@ def main(argv=None) -> int:
 
     request_host_devices(args.host_devices)
 
-    from cuvite_tpu.serve.queue import LouvainServer, ServeConfig
     from cuvite_tpu.utils.compile_cache import enable_compile_cache
     from cuvite_tpu.utils.trace import Tracer
 
@@ -101,10 +151,40 @@ def main(argv=None) -> int:
         rec_ctx = recorder
     tracer = Tracer(recorder=recorder)
 
-    server = LouvainServer(
-        ServeConfig(b_max=args.b_max, linger_s=args.linger_ms / 1e3,
-                    threshold=args.threshold, engine=args.engine),
-        tracer=tracer)
+    try:
+        config, faults, make = _make_server(args)
+    except ValueError as e:
+        print(f"# config error: {e}", file=sys.stderr)
+        return 2
+    server = make(config, tracer=tracer, faults=faults)
+
+    if args.cmd == "daemon":
+        import signal
+
+        from cuvite_tpu.serve.daemon import ServeDaemon
+
+        if (args.socket is None) == (args.port is None):
+            print("# daemon needs exactly one of --socket / --port",
+                  file=sys.stderr)
+            return 2
+        daemon = ServeDaemon(server, sock_path=args.socket,
+                             host=args.host, port=args.port)
+        with rec_ctx:
+            daemon.start()
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                signal.signal(sig, lambda *_a: daemon.request_drain())
+            # The readiness line tells harnesses (tests, the load
+            # generator, the TPU ladder) when to connect and where.
+            print(json.dumps({"ready": {
+                "socket": args.socket, "port": daemon.port,
+                "b_max": config.b_max, "engine": config.engine,
+                "admission": config.admission is not None,
+                "fault_plan": faults.spec()}}), flush=True)
+            summary = daemon.serve_forever()
+        print(json.dumps({"serve_summary": summary}), flush=True)
+        # Per-job failures are handled per job (isolated, reported);
+        # a clean drain is a clean exit.
+        return 0
 
     t0 = time.perf_counter()
     with rec_ctx:
@@ -132,7 +212,11 @@ def main(argv=None) -> int:
                     if jid in by_id:  # failed jobs have no result
                         write_communities(path + ".communities",
                                           by_id[jid].communities)
-    wall = time.perf_counter() - t0
+        wall = time.perf_counter() - t0
+        summary = dict(server.stats.to_dict(), wall_s=round(wall, 3),
+                       wall_jobs_per_s=round(len(finished) / max(wall, 1e-9),
+                                             2))
+        tracer.event("serve_summary", **summary)
 
     if args.json:
         for jid, res in finished:
@@ -143,8 +227,6 @@ def main(argv=None) -> int:
                 "phases": len(res.phases),
                 "iterations": int(res.total_iterations),
             }))
-    summary = dict(server.stats.to_dict(), wall_s=round(wall, 3),
-                   wall_jobs_per_s=round(len(finished) / max(wall, 1e-9), 2))
     if server.failures:
         summary["failures"] = [
             {"job": ids.get(jid, jid), "error": err}

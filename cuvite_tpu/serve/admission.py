@@ -1,0 +1,155 @@
+"""Admission control + load shedding for the serving queue.
+
+The batched queue (serve/queue.py) bounds nothing by itself: under
+sustained overload its bins grow without limit and every job's
+enqueue->dispatch wait grows with them — the classic unbounded-queue
+failure where the service is "up" but no request meets its latency
+target.  This module bounds the system at INTAKE instead:
+
+* **Admission control** — each class's queue depth is bounded by what
+  the measured service rate says can still meet the ``wait_p95`` SLO.
+  The controller keeps a sliding-window MEDIAN of per-batch service
+  seconds per class (observed after every dispatch; median, so a cold
+  first batch's XLA compile cannot poison the estimate) and projects
+  a new job's wait as
+  ``floor(depth / b_max) * est_batch_s`` — the full batches that must
+  complete before the job's own batch can dispatch; when the
+  projection breaches the SLO the job is REJECTED at submit with a
+  structured
+  ``retry_after_s`` (the time by which the projection says the backlog
+  will have drained enough to admit) — callers back off instead of
+  piling on.  Cold start (no estimate yet) admits: the controller can
+  only bound what it has measured.
+
+* **Deadline shedding** — jobs may carry ``deadline_s``; an expired
+  job is SHED at pop time, before packing (a batch row spent on a job
+  whose client already gave up is pure waste — worse, it delays jobs
+  that can still make their deadlines).  Shedding happens in the queue
+  (serve/queue.py), not here; this module just owns the vocabulary.
+
+Every rejection is a terminal outcome in the job-conservation
+invariant: an arriving job ends exactly once as done / failed /
+rejected / shed.  Stdlib-only, clock-free (the queue passes depths and
+observations in; deadlines run on the queue's injectable clock).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import statistics
+
+
+class AdmissionReject(RuntimeError):
+    """Raised by ``LouvainServer.submit`` when admission control turns
+    a job away.  ``retry_after_s`` is the structured backpressure
+    signal: the earliest time the projection says a resubmit could be
+    admitted.  Daemon clients receive it as
+    ``{"ok": false, "rejected": true, "retry_after_s": ...}``."""
+
+    def __init__(self, retry_after_s: float, reason: str):
+        self.retry_after_s = float(retry_after_s)
+        self.reason = reason
+        super().__init__(
+            f"admission rejected: {reason} (retry_after_s="
+            f"{self.retry_after_s:.3f})")
+
+
+@dataclasses.dataclass
+class AdmissionConfig:
+    """Knobs.  ``wait_slo_s`` is the queue-wait p95 target the
+    controller defends; ``window`` is how many recent batch service
+    times the per-class MEDIAN estimator keeps (a median, not an EWMA,
+    on purpose: the first dispatch of a class carries its XLA compile
+    — seconds against a tens-of-ms steady state — and an EWMA drags
+    that outlier through many batches of decay, slamming intake shut
+    on a freshly-started daemon; the median sheds it as soon as two
+    normal batches follow); ``headroom`` scales the projection (>1.0
+    rejects earlier).  The headroom default aims the projection ~20%
+    inside the SLO: the estimator lags a rising service time, the
+    queue depth cannot see the batch already in flight, and the
+    linger window adds slack on top — a controller that aims exactly
+    at the SLO lands just past it under sustained overload (measured:
+    wait_p95 512 ms against a 500 ms SLO at 2x saturation with
+    headroom 1.0; BASELINE.md round-13)."""
+
+    wait_slo_s: float = 2.0
+    window: int = 16
+    headroom: float = 1.25
+
+    def __post_init__(self) -> None:
+        if self.wait_slo_s <= 0:
+            raise ValueError(f"wait_slo_s must be > 0, got {self.wait_slo_s}")
+        if self.window < 1:
+            raise ValueError(f"window must be >= 1, got {self.window}")
+        if self.headroom <= 0:
+            raise ValueError(f"headroom must be > 0, got {self.headroom}")
+
+
+class AdmissionController:
+    """Per-class service-time estimator + admit/reject decision.
+
+    The queue calls :meth:`observe` after every completed dispatch
+    (measured ``busy_s`` of the batch, on the injectable clock) and
+    :meth:`decide` on every submit.  The derived per-class depth bound
+    is ``(floor(wait_slo_s / (headroom * est_batch_s)) + 1) * b_max``
+    jobs — expressed below as a wait projection so the reject response
+    can carry an honest ``retry_after_s``.
+    """
+
+    def __init__(self, config: AdmissionConfig | None = None):
+        self.config = config or AdmissionConfig()
+        # class key -> deque of recent batch service seconds (median
+        # estimator; see AdmissionConfig.window for why not an EWMA).
+        self._obs: dict = {}
+
+    def estimate(self, key) -> float | None:
+        """Median batch-service seconds for a class over the recent
+        window (None before the first observation)."""
+        obs = self._obs.get(key)
+        return statistics.median(obs) if obs else None
+
+    def observe(self, key, busy_s: float) -> None:
+        obs = self._obs.get(key)
+        if obs is None:
+            obs = self._obs[key] = collections.deque(
+                maxlen=self.config.window)
+        obs.append(busy_s)
+
+    def reset(self, key=None) -> None:
+        """Forget observations (one class, or all): the estimator
+        restarts cold and admits until re-measured."""
+        if key is None:
+            self._obs.clear()
+        else:
+            self._obs.pop(key, None)
+
+    def projected_wait_s(self, key, depth: int, b_max: int) -> float | None:
+        """Projected enqueue->dispatch wait of a job joining a class
+        bin that already holds ``depth`` jobs (None = no estimate
+        yet): ``floor(depth/b_max)`` FULL batches must complete before
+        the job's own batch can dispatch, each costing one estimated
+        service window.  The job's own batch service is deliberately
+        NOT counted — the SLO defends queue wait (enqueue->dispatch),
+        and a job joining an empty bin dispatches within the linger
+        window regardless of how long its batch then runs; counting
+        the own-batch window would permanently lock out any class
+        whose batch service exceeds ``slo/headroom`` even at depth 0
+        (rejecting traffic an idle server could serve)."""
+        est = self.estimate(key)
+        if est is None:
+            return None
+        return (depth // b_max) * est * self.config.headroom
+
+    def decide(self, key, depth: int, b_max: int) -> float | None:
+        """None = admit; else the ``retry_after_s`` to reject with.
+
+        ``retry_after_s`` is how long until enough backlog has drained
+        that the same projection would admit: the excess wait beyond
+        the SLO, floored at one batch service window (an immediate
+        resubmit would meet the same queue)."""
+        projected = self.projected_wait_s(key, depth, b_max)
+        if projected is None or projected <= self.config.wait_slo_s:
+            return None
+        est = self.estimate(key) * self.config.headroom
+        return max(projected - self.config.wait_slo_s, est)

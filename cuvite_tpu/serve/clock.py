@@ -1,0 +1,34 @@
+"""Injectable-clock plumbing: the ONE sanctioned wall-clock site in
+``serve/`` (graftlint R016 exempts exactly this module).
+
+Every deadline in the serving layer — linger, job ``deadline_s``
+shedding, admission ``retry_after_s``, retry backoff — must run on a
+clock the caller can inject, because a deadline that reads
+``time.monotonic()`` directly is untestable: the only way to drive it
+is to actually sleep, and a suite that sleeps its way through linger
+windows is both slow and flaky.  The queue/daemon/load-generator all
+take ``clock=`` (and ``sleep=``) parameters defaulting to the
+functions below; tests pass a fake pair that advances virtual time
+instantly.
+
+``time.perf_counter()`` stays allowlisted everywhere in ``serve/``:
+busy-window timing (how long the batched driver ran) measures real
+elapsed work and is never compared against an injectable deadline.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def monotonic() -> float:
+    """Default serving clock (seconds, monotonic)."""
+    return time.monotonic()
+
+
+def sleep(seconds: float) -> None:
+    """Default serving sleep (the retry-backoff / poll-wait partner of
+    :func:`monotonic`); injectable so tests advance a fake clock
+    instead of blocking."""
+    if seconds > 0:
+        time.sleep(seconds)

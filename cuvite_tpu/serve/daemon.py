@@ -1,0 +1,397 @@
+"""Async serving daemon: socket intake + dispatcher thread + drain.
+
+Turns the synchronous :class:`~cuvite_tpu.serve.queue.LouvainServer`
+into a long-lived service:
+
+  * **Intake** — newline-delimited JSON over a unix-domain socket
+    (``--socket PATH``) or a TCP port (``--port N``), stdlib only.
+    Each connection gets a reader thread; requests are dicts with an
+    ``op``: ``submit`` (a graph spec + optional ``tenant`` /
+    ``deadline_s``), ``stats`` (a ServeStats snapshot — the poll that
+    makes the stats lock a real requirement), ``drain`` (programmatic
+    graceful shutdown, same path as SIGTERM).
+
+  * **Dispatcher** — ONE thread owns ``LouvainServer.step()``; it
+    wakes on submit or every ``poll_s`` (to fire linger deadlines) and
+    routes each finished/failed/shed job back to the connection that
+    submitted it.  All server state is guarded by one lock: intake
+    mutates the queue only under it, so the dispatcher's view is
+    always consistent.
+
+  * **Graceful drain** — ``request_drain()`` (wired to SIGTERM/SIGINT
+    by the CLI) closes intake, flushes every queued bin via
+    ``drain()`` (expired jobs still shed, poison jobs still isolate),
+    emits the final ServeStats as a ``serve_summary`` event, notifies
+    clients, and lets ``serve_forever`` return — the process then
+    exits 0.  Jobs submitted after the drain began are refused with
+    ``{"ok": false, "draining": true}``.
+
+Wire protocol (one JSON object per line, both directions)::
+
+    -> {"op": "submit", "graph": {"nv": 4, "src": [0,1], "dst": [1,2],
+        "w": [1.0, 1.0]}, "tenant": "t0", "deadline_s": 2.5}
+    <- {"ok": true, "job_id": "job-0"}
+    -> {"op": "submit", "synth": {"edges": 4096, "seed": 7}}
+    <- {"ok": false, "rejected": true, "retry_after_s": 0.81}
+    <- {"result": {"job_id": "job-0", "q": 0.71, "communities": 9,
+        "phases": 2, "iterations": 11}}
+    <- {"failed": {"job_id": "job-3", "error": "..."}}
+    <- {"shed": {"job_id": "job-4", "late_s": 0.12}}
+
+Graph specs: inline ``graph`` (nv/src/dst/optional w), ``file`` (a
+Vite binary path readable by the daemon), or ``synth`` (the
+deterministic workload generator — the load generator's compact spec:
+both sides derive the same graph from (edges, seed)).  ``"labels":
+true`` on a submit adds the full per-vertex label array to the result
+line (small graphs; the chaos harness uses it for bit-identity
+checks).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import socket
+import threading
+
+from cuvite_tpu.serve.admission import AdmissionReject
+from cuvite_tpu.serve.queue import LouvainServer
+
+# The server's auto-generated job-id namespace (queue.py: f"job-{n}");
+# client-supplied ids may not squat on it (route-collision hazard).
+_AUTO_ID = re.compile(r"job-\d+")
+
+
+def _decode_graph(req: dict):
+    """Build a Graph from a submit request's spec (exactly one of
+    ``graph`` / ``file`` / ``synth``)."""
+    import numpy as np
+
+    specs = [k for k in ("graph", "file", "synth") if k in req]
+    if len(specs) != 1:
+        raise ValueError(
+            f"submit needs exactly one of graph/file/synth, got {specs}")
+    if "graph" in req:
+        from cuvite_tpu.core.graph import Graph
+
+        g = req["graph"]
+        w = g.get("w")
+        return Graph.from_edges(
+            int(g["nv"]),
+            np.asarray(g["src"], dtype=np.int64),
+            np.asarray(g["dst"], dtype=np.int64),
+            weights=(np.asarray(w, dtype=np.float64)
+                     if w is not None else None))
+    if "file" in req:
+        from cuvite_tpu.io.vite import read_vite
+
+        return read_vite(req["file"], bits64=bool(req.get("bits64")))
+    from cuvite_tpu.workloads.synth import synthesize_graph
+
+    s = req["synth"]
+    return synthesize_graph(int(s["edges"]), seed=int(s["seed"]))
+
+
+class _Client:
+    """One connection: a line reader thread plus a write lock (the
+    dispatcher and the reader both write response lines).  The socket
+    carries a timeout (``ServeDaemon.io_timeout_s``): a send that
+    cannot complete within it marks the client dead — the ONE
+    dispatcher thread must never block on a tenant that stopped
+    reading (head-of-line starvation of every other tenant); read
+    timeouts just mean the client is idle and the reader keeps
+    listening."""
+
+    def __init__(self, daemon: "ServeDaemon", conn: socket.socket,
+                 idx: int):
+        self.daemon = daemon
+        self.conn = conn
+        self.idx = idx
+        self.wlock = threading.Lock()
+        self.thread = threading.Thread(
+            target=self._read_loop, name=f"serve-client-{idx}", daemon=True)
+
+    def send(self, payload: dict) -> bool:
+        """False = the client is dead or too slow to take the payload
+        (callers drop it); never blocks past the socket timeout."""
+        data = (json.dumps(payload) + "\n").encode()
+        try:
+            with self.wlock:
+                self.conn.sendall(data)
+            return True
+        except OSError:   # includes socket.timeout: a non-reading peer
+            return False
+
+    def _read_loop(self) -> None:
+        buf = bytearray()
+        limit = self.daemon.max_line_bytes
+        try:
+            while True:
+                try:
+                    chunk = self.conn.recv(1 << 16)
+                except socket.timeout:
+                    continue          # idle client: keep listening
+                except OSError:
+                    break
+                if not chunk:
+                    break             # orderly close
+                buf.extend(chunk)
+                if len(buf) > limit and buf.find(b"\n") < 0:
+                    # A newline-free stream past the line cap is a
+                    # broken or hostile client; dropping IT beats
+                    # growing the buffer until the daemon OOMs and
+                    # takes every other tenant down.
+                    self.send({"ok": False,
+                               "error": f"request line exceeds "
+                                        f"{limit} bytes"})
+                    break
+                while True:
+                    nl = buf.find(b"\n")
+                    if nl < 0:
+                        break
+                    line = bytes(buf[:nl]).decode("utf-8",
+                                                  "replace").strip()
+                    del buf[:nl + 1]
+                    if not line:
+                        continue
+                    try:
+                        req = json.loads(line)
+                    except json.JSONDecodeError as e:
+                        self.send({"ok": False, "error": f"bad json: {e}"})
+                        continue
+                    self.send(self.daemon.handle(req, self))
+        finally:
+            self.daemon._forget(self)
+
+
+class ServeDaemon:
+    """The async service around a LouvainServer (see module docstring).
+
+    ``poll_s`` bounds how late a linger deadline can fire when no
+    submits arrive to wake the dispatcher; it defaults to half the
+    server's linger window (floored at 5 ms).
+    """
+
+    def __init__(self, server: LouvainServer, *, sock_path: str | None = None,
+                 host: str = "127.0.0.1", port: int | None = None,
+                 poll_s: float | None = None, io_timeout_s: float = 10.0,
+                 max_line_bytes: int = 64 << 20):
+        if (sock_path is None) == (port is None):
+            raise ValueError("exactly one of sock_path / port required")
+        self.server = server
+        self.sock_path = sock_path
+        self.host = host
+        self.port = port
+        self.poll_s = (poll_s if poll_s is not None
+                       else max(server.config.linger_s / 2.0, 0.005))
+        self.io_timeout_s = io_timeout_s
+        self.max_line_bytes = max_line_bytes
+        self.lock = threading.RLock()        # guards `server` wholesale
+        self._wake = threading.Event()       # submit -> dispatcher
+        self._drain_req = threading.Event()
+        self._done = threading.Event()
+        self._listener: socket.socket | None = None
+        self._clients: dict = {}
+        self._routes: dict = {}     # job_id -> (client, want_labels)
+        self._accept_thread: threading.Thread | None = None
+        self._dispatch_thread: threading.Thread | None = None
+        self.summary: dict | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self.sock_path is not None:
+            if os.path.exists(self.sock_path):
+                os.unlink(self.sock_path)
+            ls = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            ls.bind(self.sock_path)
+        else:
+            ls = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            ls.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            ls.bind((self.host, self.port))
+            self.port = ls.getsockname()[1]   # resolve port 0
+        ls.listen(16)
+        ls.settimeout(0.2)                    # accept loop polls the stop flag
+        self._listener = ls
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="serve-accept", daemon=True)
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatch_loop, name="serve-dispatch", daemon=True)
+        self._accept_thread.start()
+        self._dispatch_thread.start()
+
+    def request_drain(self) -> None:
+        """Begin graceful shutdown (idempotent; signal-handler safe:
+        only sets events)."""
+        self._drain_req.set()
+        self._wake.set()
+
+    def serve_forever(self, timeout: float | None = None) -> dict:
+        """Block until the drain completes; returns the final summary
+        (also emitted as the ``serve_summary`` trace event)."""
+        self._done.wait(timeout)
+        if not self._done.is_set():
+            raise TimeoutError("daemon did not drain within the timeout")
+        self._dispatch_thread.join(timeout=10.0)
+        return self.summary
+
+    # -- intake -------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        idx = 0
+        while not self._drain_req.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            conn.settimeout(self.io_timeout_s)
+            client = _Client(self, conn, idx)
+            idx += 1
+            self._clients[id(client)] = client
+            client.thread.start()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        if self.sock_path is not None:
+            try:
+                os.unlink(self.sock_path)
+            except OSError:
+                pass
+
+    def _forget(self, client: _Client) -> None:
+        self._clients.pop(id(client), None)
+        try:
+            client.conn.close()
+        except OSError:
+            pass
+
+    def handle(self, req: dict, client: _Client) -> dict:
+        op = req.get("op")
+        if op == "submit":
+            return self._handle_submit(req, client)
+        if op == "stats":
+            # The stats poll that makes ServeStats' lock a requirement:
+            # this runs on a reader thread while the dispatcher appends.
+            # (stats.to_dict() is safe under its own lock; the daemon
+            # lock additionally keeps the bin dict stable for pending.)
+            with self.lock:
+                return {"ok": True, "stats": self.server.stats.to_dict(),
+                        "pending": self.server.pending(),
+                        "conservation": self.server.conservation()}
+        if op == "drain":
+            self.request_drain()
+            return {"ok": True, "draining": True}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _handle_submit(self, req: dict, client: _Client) -> dict:
+        if self._drain_req.is_set():
+            return {"ok": False, "draining": True,
+                    "error": "daemon is draining; not accepting jobs"}
+        try:
+            graph = _decode_graph(req)
+        except Exception as e:  # noqa: BLE001 — protocol boundary
+            return {"ok": False, "error": f"bad graph spec: {e!r}"}
+        try:
+            with self.lock:
+                # Re-check under the lock: the dispatcher only exits
+                # once drain_req is set AND the queue is empty, so a
+                # submit that sees drain_req here can never enqueue a
+                # job the drain would miss.
+                if self._drain_req.is_set():
+                    return {"ok": False, "draining": True,
+                            "error": "daemon is draining; "
+                                     "not accepting jobs"}
+                rid = req.get("id")
+                if rid is not None:
+                    # A duplicate id would overwrite the first job's
+                    # route: its result would be DELIVERED TO THE
+                    # WRONG CLIENT and the second job's dropped.  The
+                    # 'job-N' namespace is reserved outright — the
+                    # server's auto-generated ids live there, and a
+                    # client squatting on one collides with a future
+                    # auto id no in-flight check can foresee.
+                    if _AUTO_ID.fullmatch(str(rid)):
+                        return {"ok": False,
+                                "error": f"job id {rid!r} is reserved "
+                                         "(server-generated namespace "
+                                         "'job-<n>'); pick another"}
+                    if rid in self._routes:
+                        return {"ok": False,
+                                "error": f"duplicate job id {rid!r} "
+                                         "still in flight"}
+                job_id = self.server.submit(
+                    graph, rid,
+                    tenant=str(req.get("tenant", "anon")),
+                    deadline_s=req.get("deadline_s"))
+                self._routes[job_id] = (client, bool(req.get("labels")))
+        except AdmissionReject as e:
+            return {"ok": False, "rejected": True,
+                    "retry_after_s": round(e.retry_after_s, 6),
+                    "reason": e.reason}
+        except Exception as e:  # noqa: BLE001 — injected submit faults etc.
+            return {"ok": False, "error": repr(e)}
+        self._wake.set()
+        return {"ok": True, "job_id": job_id}
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _send_or_drop(self, client: _Client | None, payload: dict) -> None:
+        """Deliver to a client, dropping the CONNECTION (not the
+        dispatcher) when it is dead or too slow to read — one stalled
+        tenant must never head-of-line-block everyone else's results."""
+        if client is not None and not client.send(payload):
+            self._forget(client)
+
+    def _route_results(self, finished, fails, sheds) -> None:
+        for job_id, res in finished:
+            client, want_labels = self._routes.pop(job_id, (None, False))
+            payload = {"job_id": job_id,
+                       "q": round(float(res.modularity), 6),
+                       "communities": int(res.num_communities),
+                       "phases": len(res.phases),
+                       "iterations": int(res.total_iterations)}
+            if want_labels:
+                payload["labels"] = [int(x) for x in res.communities]
+            self._send_or_drop(client, {"result": payload})
+        for job_id, err in fails:
+            client, _ = self._routes.pop(job_id, (None, False))
+            self._send_or_drop(client,
+                               {"failed": {"job_id": job_id, "error": err}})
+        for job_id, late_s in sheds:
+            client, _ = self._routes.pop(job_id, (None, False))
+            self._send_or_drop(client,
+                               {"shed": {"job_id": job_id,
+                                         "late_s": round(late_s, 6)}})
+
+    def _dispatch_loop(self) -> None:
+        server = self.server
+        while True:
+            self._wake.wait(timeout=self.poll_s)
+            self._wake.clear()
+            draining = self._drain_req.is_set()
+            with self.lock:
+                finished = (server.drain() if draining
+                            else server.step())
+                # Terminal reports with no result object: the daemon
+                # CONSUMES these (clears them after copying) — a
+                # long-lived service under sustained shedding or a
+                # standing fault plan must not grow them unboundedly.
+                fails = list(server.failures)
+                server.failures.clear()
+                sheds = list(server.shed)
+                server.shed.clear()
+            self._route_results(finished, fails, sheds)
+            if draining and server.pending() == 0:
+                break
+        summary = dict(server.stats.to_dict(),
+                       conservation=self.server.conservation())
+        server.tracer.event("serve_summary", **summary)
+        self.summary = summary
+        for client in list(self._clients.values()):
+            client.send({"serve_summary": summary})
+            self._forget(client)
+        self._done.set()

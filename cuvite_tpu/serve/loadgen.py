@@ -1,0 +1,171 @@
+"""Open-loop load generation: drive a LouvainServer to saturation.
+
+Closed-loop clients (submit, wait, submit) can never demonstrate
+overload — they self-throttle.  This generator is OPEN-LOOP: job k's
+arrival time is ``t0 + k/rate`` whether or not the server kept up, so
+queue growth under overload is visible instead of hidden in client
+backpressure.  Arrivals are stamped with their SCHEDULED time
+(``submit(t_submit=...)``): a batch dispatch that blocks the loop for
+200 ms cannot understate the waits of the jobs that "arrived" during
+it.
+
+Two entry points:
+
+* :func:`run_open_loop` — one run at one arrival rate against a fresh
+  server; returns a :class:`LoadReport` (goodput, reject/shed rates,
+  wait percentiles, per-job results).
+* :func:`saturation_sweep` — geometric rate ramp that finds the
+  highest SUSTAINABLE rate: goodput within ``sustain_frac`` of the
+  offered rate AND queue-wait p95 within the SLO.  The sweep result
+  anchors the acceptance A/B (2x saturation with admission on vs off —
+  tools/serve_load.py).
+
+Everything runs on the server's injectable clock/sleep pair, so unit
+tests drive whole sweeps on a fake clock with a stub runner in
+milliseconds; the bench path uses the real clock and the real batched
+driver.  No jax imports here (the queue contract).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from cuvite_tpu.serve.admission import AdmissionReject
+from cuvite_tpu.serve.queue import LouvainServer, percentile
+
+
+@dataclasses.dataclass
+class LoadReport:
+    """One open-loop run's outcome (rates in jobs/s, waits seconds)."""
+
+    rate: float               # offered arrival rate
+    offered: int              # jobs the schedule presented
+    done: int
+    failed: int
+    rejected: int
+    shed: int
+    wall_s: float             # first arrival -> queue fully drained
+    goodput_jobs_per_s: float
+    wait_p50_s: float
+    wait_p95_s: float
+    stats: dict               # final ServeStats snapshot
+    results: list             # [(job_id, LouvainResult), ...] completed
+    conservation: dict        # LouvainServer.conservation() at the end
+
+    @property
+    def reject_rate(self) -> float:
+        return self.rejected / max(self.offered, 1)
+
+    @property
+    def shed_rate(self) -> float:
+        return self.shed / max(self.offered, 1)
+
+    def row(self) -> dict:
+        """Compact dict for sweep tables / logs."""
+        return {
+            "rate": round(self.rate, 3),
+            "offered": self.offered,
+            "done": self.done,
+            "rejected": self.rejected,
+            "shed": self.shed,
+            "failed": self.failed,
+            "goodput_jobs_per_s": round(self.goodput_jobs_per_s, 3),
+            "wait_p50_ms": round(self.wait_p50_s * 1e3, 3),
+            "wait_p95_ms": round(self.wait_p95_s * 1e3, 3),
+        }
+
+
+def run_open_loop(server: LouvainServer, graphs, rate: float, *,
+                  tenants: int = 1, deadline_s: float | None = None,
+                  max_wall_s: float = 3600.0) -> LoadReport:
+    """Offer ``graphs`` to ``server`` at ``rate`` jobs/s (open loop),
+    then drain; the server must be FRESH (stats start at zero).
+
+    ``tenants`` spreads jobs round-robin over that many tenant ids
+    (exercising the fairness pop); ``deadline_s`` attaches a relative
+    deadline to every job (the shedding path).  ``max_wall_s`` bounds
+    a pathological run on the server's clock (e.g. a misconfigured
+    rate of 1e-9) — it raises rather than spins forever.
+    """
+    if rate <= 0:
+        raise ValueError(f"rate must be > 0 jobs/s, got {rate}")
+    clock, sleep = server.clock, server.sleep
+    poll_s = max(min(server.config.linger_s / 2.0, 0.01), 1e-4)
+    finished: list = []
+    rejected = 0
+    t0 = clock()
+    i = 0
+    n = len(graphs)
+    while True:
+        now = clock()
+        if now - t0 > max_wall_s:
+            raise TimeoutError(
+                f"open-loop run exceeded max_wall_s={max_wall_s}")
+        while i < n and t0 + i / rate <= now:
+            try:
+                server.submit(graphs[i], tenant=f"t{i % tenants}",
+                              deadline_s=deadline_s,
+                              t_submit=t0 + i / rate)
+            except AdmissionReject:
+                rejected += 1
+            i += 1
+        before = len(finished)
+        finished.extend(server.step())
+        if i >= n:
+            if server.pending() == 0:
+                break
+            if len(finished) == before:
+                # Nothing was due (a partial bin waiting out its
+                # linger): advance the clock toward the deadline
+                # instead of spinning — on a fake clock this sleep IS
+                # what moves time.
+                sleep(poll_s)
+            continue
+        now = clock()
+        next_arrival = t0 + i / rate
+        if next_arrival > now:
+            sleep(min(next_arrival - now, poll_s))
+    wall = clock() - t0
+    stats = server.stats.to_dict()
+    cons = server.conservation()
+    with server.stats.lock:
+        samples = list(server.stats.wait_samples)
+    return LoadReport(
+        rate=rate, offered=n, done=stats["jobs_done"],
+        failed=stats["jobs_failed"], rejected=rejected,
+        shed=stats["jobs_shed"], wall_s=wall,
+        goodput_jobs_per_s=stats["jobs_done"] / max(wall, 1e-9),
+        wait_p50_s=percentile(samples, 50.0),
+        wait_p95_s=percentile(samples, 95.0),
+        stats=stats, results=finished, conservation=cons)
+
+
+def saturation_sweep(make_server, make_graphs, *, start_rate: float,
+                     slo_s: float, growth: float = 1.6,
+                     max_rounds: int = 8, sustain_frac: float = 0.9,
+                     tenants: int = 1,
+                     deadline_s: float | None = None) -> tuple:
+    """Geometric arrival-rate ramp; stops at the first UNSUSTAINABLE
+    rate (goodput < sustain_frac * rate, or wait p95 past the SLO).
+
+    ``make_server``/``make_graphs`` are zero-arg factories (each round
+    needs a fresh server with zeroed stats; reusing one graph list is
+    fine — factories let callers re-synthesize when graphs are
+    consumed).  Returns ``(reports, best)`` where ``best`` is the last
+    sustainable report (None if even ``start_rate`` overloads).
+    """
+    reports: list = []
+    best = None
+    rate = start_rate
+    for _ in range(max_rounds):
+        rep = run_open_loop(make_server(), make_graphs(), rate,
+                            tenants=tenants, deadline_s=deadline_s)
+        reports.append(rep)
+        sustainable = (rep.goodput_jobs_per_s >= sustain_frac * rate
+                       and rep.wait_p95_s <= slo_s
+                       and rep.rejected == 0)
+        if not sustainable:
+            break
+        best = rep
+        rate *= growth
+    return reports, best

@@ -1,4 +1,4 @@
-"""Slab-class batching queue: the serving layer over the batched driver.
+"""Slab-class batching queue: the serving core under the async daemon.
 
 Queue discipline (ISSUE 9).  Jobs bin by (slab class, accumulator
 class) — the pow2 ``(nv_pad, ne_pad)`` shape their graph canonicalizes
@@ -13,23 +13,48 @@ bin dispatches when either
     tenant of a rare class must not wait for batch-mates that never
     come).
 
-Dispatch packs up to ``b_max`` jobs, pads the batch axis to the
-``core.batch.BATCH_SIZES`` rung (so the compile cache sees a bounded
-set of ``(class, B)`` keys), runs ``louvain.batched.run_batched``, and
-unpacks per-tenant results in submission order.  Padding rows are the
-pack tax: ``pack_util`` (real rows / padded rows) is the serving
-metric that prices it, and it rides the bench record's ``batch`` block.
+Inside a bin jobs live in PER-TENANT sub-queues and pack by
+round-robin pop across tenants (ISSUE 11): a tenant streaming 1000
+jobs gets at most its fair share of each batch's ``b_max`` rows, and
+other tenants' jobs dispatch within ~one batch instead of queueing
+behind the firehose.  The linger deadline reads the oldest job across
+ALL tenants of the bin, so the firehose cannot hold it hostage either.
+
+Robustness layer (ISSUE 11), in path order:
+
+  * **admission** — with ``ServeConfig.admission`` set, submit rejects
+    (``AdmissionReject`` with ``retry_after_s``) when the class's
+    measured service rate projects the new job's wait past the
+    ``wait_slo_s`` SLO (serve/admission.py);
+  * **shedding** — jobs carrying ``deadline_s`` are dropped at pop
+    time once expired, BEFORE packing: an expired job never occupies a
+    batch row;
+  * **fault injection + retry** — a ``FaultPlan`` (serve/faults.py)
+    fires at the named dispatch sites; transient faults retry the
+    batch with exponential backoff on the injectable clock/sleep pair,
+    permanent ones flow to the poison isolation machinery (the batch
+    splits, batchmates survive, the job fails exactly once).
+
+Job conservation is the load-bearing invariant: every ADMITTED job
+terminates exactly once as done, failed, or shed (rejected jobs never
+enter the queue and are their own terminal state) —
+``jobs_done + jobs_failed + jobs_shed + pending() == jobs_submitted``
+at all times; :meth:`LouvainServer.conservation` spells it out and the
+chaos tests assert it under randomized seeded fault plans.
 
 This module deliberately contains NO jax calls: the compiled program
 lives at module scope in louvain/batched.py, device placement happens
 once per packed batch inside the driver.  graftlint R014 enforces the
 corresponding trap (jit/vmap construction or per-job device_put inside
 a serve/ queue loop — the compile-per-job and upload-per-job mistakes
-that would silently erase the batching win).
+that would silently erase the batching win), and R016 keeps every
+deadline on the injectable clock (serve/clock.py is the one sanctioned
+wall-clock site; ``time.perf_counter`` busy-timing stays allowlisted).
 
 Observability: every dispatch opens a ``pack`` span (class, jobs, B,
-linger-triggered or full) and emits one ``tenant_result`` event per
-job; OBSERVABILITY.md documents the fields.
+trigger) and emits one ``tenant_result`` event per job; the robustness
+paths add ``admit``/``reject``/``shed``/``retry`` events and a
+``drain`` span — OBSERVABILITY.md documents the fields.
 """
 
 from __future__ import annotations
@@ -37,7 +62,8 @@ from __future__ import annotations
 import collections
 import dataclasses
 import itertools
-import time
+import threading
+import warnings
 
 from cuvite_tpu.core.batch import (
     BATCH_ENGINES,
@@ -46,12 +72,20 @@ from cuvite_tpu.core.batch import (
     slab_class_of,
 )
 from cuvite_tpu.core.types import TERMINATION_PHASE_COUNT
+from cuvite_tpu.serve import clock as serve_clock
+from cuvite_tpu.serve.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    AdmissionReject,
+)
+from cuvite_tpu.serve.faults import FaultPlan, InjectedFault
 
 
 @dataclasses.dataclass
 class ServeConfig:
     """Queue knobs.  ``b_max`` should be a BATCH_SIZES rung (it is
-    clamped to one): it caps both batch latency amortization and the
+    rounded to one, with a warning when that CHANGES the requested
+    value): it caps both batch latency amortization and the
     compile-cache footprint per class.  ``linger_s`` bounds the extra
     latency batching may add to any single job.
 
@@ -61,7 +95,14 @@ class ServeConfig:
     at the serving-coarse class; the configuration every per-graph AND
     batched benchmark shows is the fast one) or ``'fused'`` (PR 9's
     all-phases sort-formulation loop).  Engine choice never changes
-    results — per-tenant labels/Q are bit-identical across engines."""
+    results — per-tenant labels/Q are bit-identical across engines.
+
+    Robustness knobs (ISSUE 11): ``admission`` — an
+    :class:`~cuvite_tpu.serve.admission.AdmissionConfig` enables
+    SLO-projected admission control (None = admit everything, the
+    library default); ``max_retries``/``retry_base_s`` bound the
+    transient-fault retry loop (backoff = base * 2**(attempt-1), slept
+    on the server's injectable sleep)."""
 
     b_max: int = 64
     linger_s: float = 0.05
@@ -69,16 +110,43 @@ class ServeConfig:
     max_phases: int = TERMINATION_PHASE_COUNT
     mesh: object = "auto"   # forwarded to run_batched
     engine: str = "bucketed"
+    admission: AdmissionConfig | None = None
+    max_retries: int = 3
+    retry_base_s: float = 0.05
 
     def __post_init__(self) -> None:
+        # Config-time validation (ISSUE 11 satellite): a bad knob must
+        # refuse HERE, not deep in the driver mid-dispatch.
         if self.b_max < 1:
             raise ValueError("b_max must be >= 1")
+        if self.linger_s < 0:
+            raise ValueError(f"linger_s must be >= 0, got {self.linger_s}")
+        if self.threshold <= 0:
+            raise ValueError(f"threshold must be > 0, got {self.threshold}")
+        if self.max_retries < 0:
+            raise ValueError(
+                f"max_retries must be >= 0, got {self.max_retries}")
+        if self.retry_base_s < 0:
+            raise ValueError(
+                f"retry_base_s must be >= 0, got {self.retry_base_s}")
         if self.engine not in BATCH_ENGINES:
             raise ValueError(f"unknown serving engine {self.engine!r}; "
                              f"use one of {BATCH_ENGINES}")
+        if self.admission is not None \
+                and not isinstance(self.admission, AdmissionConfig):
+            raise ValueError(
+                "admission must be an AdmissionConfig (or None to "
+                f"disable admission control), got {self.admission!r}")
         # Round up to a ladder rung (full bins then pack with zero
-        # padding), capped at the ladder top.
-        self.b_max = min(batch_pad(self.b_max), BATCH_SIZES[-1])
+        # padding), capped at the ladder top — loudly: a silently
+        # clamped b_max=1000 serving 64-row batches would mislead
+        # capacity planning.
+        rung = min(batch_pad(self.b_max), BATCH_SIZES[-1])
+        if rung != self.b_max:
+            warnings.warn(
+                f"b_max={self.b_max} is not a BATCH_SIZES rung; "
+                f"using {rung} (ladder {BATCH_SIZES})", stacklevel=2)
+        self.b_max = rung
 
 
 @dataclasses.dataclass
@@ -87,6 +155,53 @@ class Job:
     graph: object
     slab_class: tuple
     t_submit: float
+    tenant: str = "anon"
+    # Absolute deadline on the server clock (None = never sheds).
+    t_deadline: float | None = None
+
+
+class _ClassBin:
+    """One (slab class, accum class) bin: per-tenant FIFO sub-queues
+    with a round-robin pop cursor (the fairness unit — each pop takes
+    the front job of the front tenant and rotates that tenant to the
+    back)."""
+
+    __slots__ = ("tenants", "order")
+
+    def __init__(self):
+        self.tenants: dict = {}              # tenant -> deque[Job]
+        self.order: collections.deque = collections.deque()
+
+    def push(self, job: Job) -> None:
+        q = self.tenants.get(job.tenant)
+        if q is None:
+            q = self.tenants[job.tenant] = collections.deque()
+            self.order.append(job.tenant)
+        q.append(job)
+
+    def depth(self) -> int:
+        return sum(len(q) for q in self.tenants.values())
+
+    def oldest_t_submit(self) -> float | None:
+        """Oldest enqueue time across ALL tenants (the linger clock:
+        a firehose tenant cannot hide another tenant's aging job)."""
+        heads = [q[0].t_submit for q in self.tenants.values() if q]
+        return min(heads) if heads else None
+
+    def pop_rr(self) -> Job | None:
+        while self.order:
+            t = self.order.popleft()
+            q = self.tenants.get(t)
+            if not q:
+                self.tenants.pop(t, None)
+                continue
+            job = q.popleft()
+            if q:
+                self.order.append(t)
+            else:
+                self.tenants.pop(t, None)
+            return job
+        return None
 
 
 # Queue-wait sample window (ISSUE 10): percentiles cover the most
@@ -108,16 +223,26 @@ def percentile(samples, q: float) -> float:
 
 @dataclasses.dataclass
 class ServeStats:
-    """Aggregate serving counters (monotone; read any time).  The
-    queue-wait percentiles (enqueue -> dispatch, driven by the server's
-    injectable clock) price the latency the batching discipline ADDS:
-    a p95 near ``linger_s`` means jobs mostly wait out the deadline
-    (rare classes / low traffic); a p95 near zero means bins fill and
-    dispatch full (the amortization regime)."""
+    """Aggregate serving counters.  The queue-wait percentiles
+    (enqueue -> dispatch, driven by the server's injectable clock)
+    price the latency the batching discipline ADDS: a p95 near
+    ``linger_s`` means jobs mostly wait out the deadline (rare classes
+    / low traffic); a p95 near zero means bins fill and dispatch full
+    (the amortization regime).
 
-    jobs_submitted: int = 0
+    Thread-safety (ISSUE 11 satellite): the daemon's dispatcher
+    appends ``wait_samples`` while intake threads poll ``to_dict()``
+    or the percentile properties — every read snapshots (and every
+    write lands) under ``lock`` (an RLock, so ``to_dict`` can read the
+    properties it reuses).  Single-threaded callers pay one
+    uncontended acquire."""
+
+    jobs_submitted: int = 0   # ADMITTED jobs (rejections never enqueue)
     jobs_done: int = 0
     jobs_failed: int = 0
+    jobs_rejected: int = 0    # admission turned the job away at submit
+    jobs_shed: int = 0        # deadline expired before dispatch
+    retries: int = 0          # transient-fault batch retries
     batches: int = 0
     rows_real: int = 0
     rows_padded: int = 0     # total batch rows incl. padding
@@ -126,65 +251,93 @@ class ServeStats:
     # enqueue->dispatch waits of the last WAIT_WINDOW jobs (seconds).
     wait_samples: collections.deque = dataclasses.field(
         default_factory=lambda: collections.deque(maxlen=WAIT_WINDOW))
+    lock: threading.RLock = dataclasses.field(
+        default_factory=threading.RLock, repr=False, compare=False)
 
     @property
     def pack_util(self) -> float:
-        return self.rows_real / max(self.rows_padded, 1)
+        with self.lock:
+            return self.rows_real / max(self.rows_padded, 1)
 
     @property
     def jobs_per_s(self) -> float:
-        return self.jobs_done / max(self.busy_s, 1e-9)
+        with self.lock:
+            return self.jobs_done / max(self.busy_s, 1e-9)
 
     @property
     def wait_p50_s(self) -> float:
-        return percentile(self.wait_samples, 50.0)
+        with self.lock:
+            samples = list(self.wait_samples)
+        return percentile(samples, 50.0)
 
     @property
     def wait_p95_s(self) -> float:
-        return percentile(self.wait_samples, 95.0)
+        with self.lock:
+            samples = list(self.wait_samples)
+        return percentile(samples, 95.0)
 
     def to_dict(self) -> dict:
-        return {
-            "jobs_submitted": self.jobs_submitted,
-            "jobs_done": self.jobs_done,
-            "jobs_failed": self.jobs_failed,
-            "batches": self.batches,
-            "pack_util": round(self.pack_util, 4),
-            "linger_dispatches": self.linger_dispatches,
-            "busy_s": round(self.busy_s, 4),
-            "jobs_per_s": round(self.jobs_per_s, 2),
-            "wait_p50_ms": round(self.wait_p50_s * 1e3, 3),
-            "wait_p95_ms": round(self.wait_p95_s * 1e3, 3),
-        }
+        with self.lock:
+            samples = list(self.wait_samples)
+            out = {
+                "jobs_submitted": self.jobs_submitted,
+                "jobs_done": self.jobs_done,
+                "jobs_failed": self.jobs_failed,
+                "jobs_rejected": self.jobs_rejected,
+                "jobs_shed": self.jobs_shed,
+                "retries": self.retries,
+                "batches": self.batches,
+                "pack_util": round(self.pack_util, 4),
+                "linger_dispatches": self.linger_dispatches,
+                "busy_s": round(self.busy_s, 4),
+                "jobs_per_s": round(self.jobs_per_s, 2),
+            }
+        out["wait_p50_ms"] = round(percentile(samples, 50.0) * 1e3, 3)
+        out["wait_p95_ms"] = round(percentile(samples, 95.0) * 1e3, 3)
+        return out
 
 
 class LouvainServer:
     """Synchronous serving core: ``submit()`` enqueues, ``step()`` runs
     every due batch and returns finished ``(job_id, LouvainResult)``
-    pairs.  A daemon wraps this in its arrival loop (serve/__main__.py);
-    keeping the core synchronous keeps results deterministic and
-    testable — the queue decides WHAT runs together, the batched driver
-    decides how.
+    pairs.  The async daemon (serve/daemon.py) wraps this in its
+    socket intake + dispatcher thread; keeping the core synchronous
+    keeps results deterministic and testable — the queue decides WHAT
+    runs together, the batched driver decides how.
 
-    ``clock`` is injectable (tests drive linger deadlines without
-    sleeping).
+    Injectables (all default to the real thing): ``clock``/``sleep``
+    (serve/clock.py — tests drive linger deadlines and retry backoff
+    without sleeping), ``faults`` (a FaultPlan; empty = no injection),
+    ``runner`` (the batch executor, signature of
+    ``louvain.batched.cluster_many`` — chaos tests swap in a stub so
+    hundreds of conservation-invariant jobs cost milliseconds).
     """
 
     def __init__(self, config: ServeConfig | None = None, tracer=None,
-                 clock=time.monotonic):
+                 clock=None, *, sleep=None, faults=None, runner=None):
         self.config = config or ServeConfig()
         if tracer is None:
             from cuvite_tpu.utils.trace import NullTracer
 
             tracer = NullTracer()
         self.tracer = tracer
-        self.clock = clock
+        self.clock = clock if clock is not None else serve_clock.monotonic
+        self.sleep = sleep if sleep is not None else serve_clock.sleep
+        self.faults = faults if faults is not None else FaultPlan()
+        self._runner = runner
         self.stats = ServeStats()
-        # Jobs whose clustering raised: (job_id, error string).  They
-        # are reported here instead of poisoning their batch — see
-        # _dispatch's isolation retry.
+        self.admission = (AdmissionController(self.config.admission)
+                          if self.config.admission is not None else None)
+        # Terminal reports for jobs that never produce a result: jobs
+        # whose clustering raised -> (job_id, error string) in
+        # ``failures`` (poison isolation, see _dispatch); jobs whose
+        # deadline expired before dispatch -> (job_id, late_s) in
+        # ``shed``.  The daemon consumes-and-CLEARS both per dispatch
+        # tick (a long-lived service must not grow them unboundedly);
+        # library callers read them after drain().
         self.failures: list = []
-        self._bins: dict = collections.defaultdict(collections.deque)
+        self.shed: list = []
+        self._bins: dict = collections.defaultdict(_ClassBin)
         # Sticky per-slab-class bucket geometry (engine='bucketed'):
         # each dispatch pins the grow-only UNION of every geometry the
         # class has served (core.batch.union_shapes), so per-batch
@@ -196,64 +349,192 @@ class LouvainServer:
 
     # -- intake -------------------------------------------------------------
 
-    def submit(self, graph, job_id: str | None = None) -> str:
+    def submit(self, graph, job_id: str | None = None, *,
+               tenant: str = "anon", deadline_s: float | None = None,
+               t_submit: float | None = None) -> str:
         """Enqueue one clustering job; returns its id.  Binning is by
         (slab class, accumulator class) — pure host arithmetic, no slab
-        is built here."""
+        is built here.
+
+        ``deadline_s`` (relative to now, on the server clock): the job
+        is SHED — never packed — once the deadline passes before
+        dispatch.  ``t_submit`` backdates the enqueue timestamp (the
+        open-loop load generator stamps scheduled arrival times so
+        queue waits are measured from arrival, not from when the
+        single-threaded loop got around to submitting).
+
+        Raises :class:`AdmissionReject` (with ``retry_after_s``) when
+        admission control is on and the class's projected wait
+        breaches the SLO; the job is then terminally REJECTED and
+        never enqueued.
+        """
         from cuvite_tpu.louvain.batched import accum_class_of
 
         if job_id is None:
             job_id = f"job-{next(self._ids)}"
         cls = slab_class_of(graph)
-        self._bins[(cls, accum_class_of(graph, cls[0]))].append(
-            Job(job_id=job_id, graph=graph, slab_class=cls,
-                t_submit=self.clock()))
-        self.stats.jobs_submitted += 1
+        key = (cls, accum_class_of(graph, cls[0]))
+        now = self.clock() if t_submit is None else t_submit
+        depth = self._bins[key].depth() if key in self._bins else 0
+        if self.admission is not None:
+            retry_after = self.admission.decide(key, depth,
+                                                self.config.b_max)
+            if retry_after is not None:
+                with self.stats.lock:
+                    self.stats.jobs_rejected += 1
+                self.tracer.event(
+                    "reject", job_id=job_id, tenant=tenant,
+                    slab_class=list(cls), depth=depth,
+                    retry_after_s=round(retry_after, 6))
+                raise AdmissionReject(
+                    retry_after,
+                    f"class {cls} depth {depth} projects past the "
+                    f"{self.config.admission.wait_slo_s}s wait SLO")
+        try:
+            self.faults.check("submit")
+        except InjectedFault:
+            # An intake fault is a REJECTION seen from the conservation
+            # ledger: the job never entered the queue, the caller got
+            # an error, and it must not count as submitted.
+            with self.stats.lock:
+                self.stats.jobs_rejected += 1
+            self.tracer.event("reject", job_id=job_id, tenant=tenant,
+                              slab_class=list(cls), depth=depth,
+                              reason="injected-fault")
+            raise
+        self._bins[key].push(
+            Job(job_id=job_id, graph=graph, slab_class=cls, t_submit=now,
+                tenant=tenant,
+                t_deadline=(now + deadline_s
+                            if deadline_s is not None else None)))
+        with self.stats.lock:
+            self.stats.jobs_submitted += 1
+        self.tracer.event("admit", job_id=job_id, tenant=tenant,
+                          slab_class=list(cls), depth=depth + 1)
         return job_id
 
     def pending(self) -> int:
-        return sum(len(q) for q in self._bins.values())
+        return sum(b.depth() for b in self._bins.values())
+
+    def pin_shape(self, slab_class: tuple, shape) -> None:
+        """Pre-pin a slab class's bucket geometry (engine='bucketed').
+        Benches and the load generator pin the JOB-SET union
+        (core.batch.bucket_shape_for) so a warm-up pass covers every
+        compiled program the run can touch; the sticky per-dispatch
+        union then never grows past it."""
+        from cuvite_tpu.core.batch import union_shapes
+
+        prev = self._shapes.get(slab_class)
+        self._shapes[slab_class] = (shape if prev is None
+                                    else union_shapes(prev, shape))
+
+    def conservation(self) -> dict:
+        """Terminal accounting — the chaos invariant: every admitted
+        job is pending or terminated exactly once
+        (``done + failed + shed + pending == submitted``; rejected
+        jobs are their own terminal state and never enqueue)."""
+        with self.stats.lock:
+            s = dict(submitted=self.stats.jobs_submitted,
+                     done=self.stats.jobs_done,
+                     failed=self.stats.jobs_failed,
+                     shed=self.stats.jobs_shed,
+                     rejected=self.stats.jobs_rejected)
+        s["pending"] = self.pending()
+        s["ok"] = (s["done"] + s["failed"] + s["shed"] + s["pending"]
+                   == s["submitted"])
+        return s
 
     # -- dispatch -----------------------------------------------------------
 
     def _due(self, now: float, force: bool) -> list:
-        """Classes with a dispatchable batch: full bins always; partial
-        bins once their oldest job lingered past the deadline (or on
-        ``force``, the drain path)."""
+        """Bin keys with a dispatchable batch: full bins always;
+        partial bins once their oldest job lingered past the deadline
+        (or on ``force``, the drain path)."""
         due = []
-        for cls, q in self._bins.items():
-            if not q:
+        for key, b in self._bins.items():
+            oldest = b.oldest_t_submit()
+            if oldest is None:
                 continue
-            if force or len(q) >= self.config.b_max \
-                    or (now - q[0].t_submit) >= self.config.linger_s:
-                due.append(cls)
+            if force or b.depth() >= self.config.b_max \
+                    or (now - oldest) >= self.config.linger_s:
+                due.append(key)
         return due
 
-    def _dispatch(self, jobs, cls, trigger, now) -> list:
-        """Run one packed batch and unpack per-tenant results.  A batch
-        whose clustering RAISES must not take its batchmates down: the
-        batch splits and each job retries alone; a job that fails alone
-        lands in ``self.failures`` (never back in the queue — a poison
-        job re-queued would raise forever)."""
-        from cuvite_tpu.louvain.batched import cluster_many
+    def _shed_job(self, job: Job, now: float) -> None:
+        late = now - job.t_deadline
+        with self.stats.lock:
+            self.stats.jobs_shed += 1
+        self.shed.append((job.job_id, late))
+        self.tracer.event("shed", job_id=job.job_id, tenant=job.tenant,
+                          slab_class=list(job.slab_class),
+                          late_s=round(late, 6))
 
+    def _pop_batch(self, b: _ClassBin, now: float) -> list:
+        """Round-robin pop up to ``b_max`` jobs, shedding expired ones
+        BEFORE they can occupy a batch row."""
+        jobs = []
+        while len(jobs) < self.config.b_max:
+            job = b.pop_rr()
+            if job is None:
+                break
+            if job.t_deadline is not None and now > job.t_deadline:
+                self._shed_job(job, now)
+                continue
+            jobs.append(job)
+        return jobs
+
+    def _run_batch(self, jobs, b_pad, shape):
+        """The driver invocation, behind the 'device' fault site."""
+        self.faults.check("device")
+        runner = self._runner
+        if runner is None:
+            from cuvite_tpu.louvain.batched import cluster_many
+
+            runner = cluster_many
+        return runner(
+            [j.graph for j in jobs],
+            threshold=self.config.threshold,
+            max_phases=self.config.max_phases,
+            b_pad=b_pad or None, mesh=self.config.mesh,
+            engine=self.config.engine, bucket_shape=shape,
+            tracer=self.tracer)
+
+    def _fail_batch(self, jobs, key, sid, busy, waits, now, err) -> list:
+        """Permanent-failure path: close the pack span, then isolate —
+        a batch whose clustering RAISES must not take its batchmates
+        down: the batch splits and each job retries alone; a job that
+        fails alone lands in ``self.failures`` (never back in the
+        queue — a poison job re-queued would raise forever)."""
+        cls, _acc = key
+        self.tracer.end_span(sid, wall_s=busy, error=repr(err))
+        with self.stats.lock:
+            self.stats.busy_s += busy
+        if len(jobs) == 1:
+            job = jobs[0]
+            with self.stats.lock:
+                self.stats.jobs_failed += 1
+                # A failed job still waited in the queue; its sample
+                # belongs in the latency percentiles like any other.
+                self.stats.wait_samples.append(waits[0])
+            self.failures.append((job.job_id, repr(err)))
+            self.tracer.event("tenant_error", job_id=job.job_id,
+                              tenant=job.tenant, slab_class=list(cls),
+                              error=repr(err))
+            return []
+        out = []
+        for job in jobs:  # isolate the poison job, save the rest
+            out.extend(self._dispatch([job], key, "isolate", now))
+        return out
+
+    def _dispatch(self, jobs, key, trigger, now) -> list:
+        """Run one packed batch and unpack per-tenant results, with
+        bounded transient-fault retry around the attempt."""
+        cls, _acc = key
         # Edgeless jobs are answered inline by cluster_many and occupy
         # no batch row: the padded shape and the pack accounting follow
         # the rows that actually hit the device.
         n_real = sum(1 for j in jobs if j.graph.num_edges > 0)
         b_pad = batch_pad(n_real) if n_real else 0
-        shape = None
-        if self.config.engine == "bucketed" and n_real:
-            from cuvite_tpu.core.batch import bucket_shape_for, union_shapes
-
-            need = bucket_shape_for(
-                [j.graph for j in jobs if j.graph.num_edges > 0])
-            prev = self._shapes.get(cls)
-            shape = need if prev is None else union_shapes(prev, need)
-            # The sticky union is recorded only AFTER the batch
-            # completes (below): a poison job with an extreme degree
-            # histogram must not inflate the class's pinned geometry
-            # forever when it never produces a result.
         # Queue-wait latency of THIS batch's jobs (enqueue -> dispatch
         # decision), on the injectable clock: per-batch percentiles ride
         # the pack span; the rolling aggregate feeds the serve summary.
@@ -261,52 +542,81 @@ class LouvainServer:
         sid = self.tracer.begin_span(
             "pack", slab_class=list(cls), jobs=len(jobs), b_pad=b_pad,
             trigger=trigger, engine=self.config.engine,
+            tenants=len({j.tenant for j in jobs}),
             wait_p50_s=round(percentile(waits, 50.0), 6),
             wait_p95_s=round(percentile(waits, 95.0), 6))
-        t0 = time.perf_counter()
-        try:
-            br = cluster_many(
-                [j.graph for j in jobs],
-                threshold=self.config.threshold,
-                max_phases=self.config.max_phases,
-                b_pad=b_pad or None, mesh=self.config.mesh,
-                engine=self.config.engine, bucket_shape=shape,
-                tracer=self.tracer)
-        except Exception as e:  # noqa: BLE001 — isolation boundary
-            busy = time.perf_counter() - t0
-            self.tracer.end_span(sid, wall_s=busy, error=repr(e))
-            self.stats.busy_s += busy
-            if len(jobs) == 1:
-                job = jobs[0]
-                self.stats.jobs_failed += 1
-                # A failed job still waited in the queue; its sample
-                # belongs in the latency percentiles like any other.
-                self.stats.wait_samples.append(waits[0])
-                self.failures.append((job.job_id, repr(e)))
-                self.tracer.event("tenant_error", job_id=job.job_id,
-                                  slab_class=list(cls), error=repr(e))
-                return []
-            out = []
-            for job in jobs:  # isolate the poison job, save the rest
-                out.extend(self._dispatch([job], cls, "isolate", now))
-            return out
-        busy = time.perf_counter() - t0
-        self.tracer.end_span(sid, wall_s=busy, phases=br.n_phases)
+        # Busy windows run on the INJECTABLE clock (not perf_counter):
+        # the admission controller's service-time estimates and the
+        # stats' busy_s must be drivable by a fake clock + stub runner,
+        # or overload behavior becomes untestable without real sleeps.
+        busy = 0.0
+        attempt = 0
+        while True:
+            t0 = self.clock()
+            try:
+                self.faults.check("pack")
+                shape = None
+                if self.config.engine == "bucketed" and n_real:
+                    from cuvite_tpu.core.batch import (
+                        bucket_shape_for,
+                        union_shapes,
+                    )
+
+                    need = bucket_shape_for(
+                        [j.graph for j in jobs if j.graph.num_edges > 0])
+                    prev = self._shapes.get(cls)
+                    shape = need if prev is None else union_shapes(prev,
+                                                                   need)
+                    # The sticky union is recorded only AFTER the batch
+                    # completes (below): a poison job with an extreme
+                    # degree histogram must not inflate the class's
+                    # pinned geometry forever when it never produces a
+                    # result.
+                self.faults.check("dispatch")
+                br = self._run_batch(jobs, b_pad, shape)
+                self.faults.check("unpack")
+            except InjectedFault as e:
+                busy += self.clock() - t0
+                if not e.permanent and attempt < self.config.max_retries:
+                    attempt += 1
+                    backoff = self.config.retry_base_s * (2 ** (attempt - 1))
+                    with self.stats.lock:
+                        self.stats.retries += 1
+                    self.tracer.event(
+                        "retry", site=e.site, attempt=attempt,
+                        jobs=len(jobs), slab_class=list(cls),
+                        backoff_s=round(backoff, 6))
+                    self.sleep(backoff)
+                    continue
+                # Permanent, or transient past the retry budget: the
+                # existing poison machinery is the terminal path.
+                return self._fail_batch(jobs, key, sid, busy, waits, now, e)
+            except Exception as e:  # noqa: BLE001 — isolation boundary
+                busy += self.clock() - t0
+                return self._fail_batch(jobs, key, sid, busy, waits, now, e)
+            busy += self.clock() - t0
+            break
+        self.tracer.end_span(sid, wall_s=busy, phases=br.n_phases,
+                             attempts=attempt + 1)
         if shape is not None:
             self._shapes[cls] = shape
-        if n_real:
-            self.stats.batches += 1
-            self.stats.rows_real += n_real
-            self.stats.rows_padded += b_pad
-        self.stats.busy_s += busy
-        if trigger == "linger":
-            self.stats.linger_dispatches += 1
+        with self.stats.lock:
+            if n_real:
+                self.stats.batches += 1
+                self.stats.rows_real += n_real
+                self.stats.rows_padded += b_pad
+            self.stats.busy_s += busy
+            if trigger == "linger":
+                self.stats.linger_dispatches += 1
+        if self.admission is not None and n_real:
+            self.admission.observe(key, busy)
         out = []
         for job, res, wait in zip(jobs, br.results, waits):
-            self.stats.jobs_done += 1
-            self.stats.wait_samples.append(wait)
+            with self.stats.lock:
+                self.stats.jobs_done += 1
+                self.stats.wait_samples.append(wait)
             self.tracer.event(
-                "tenant_result", job_id=job.job_id,
+                "tenant_result", job_id=job.job_id, tenant=job.tenant,
                 slab_class=list(cls), q=float(res.modularity),
                 phases=len(res.phases),
                 iterations=int(res.total_iterations),
@@ -317,24 +627,32 @@ class LouvainServer:
 
     def step(self, now: float | None = None, force: bool = False) -> list:
         """Run every due batch; returns [(job_id, LouvainResult), ...]
-        in submission order per batch.  One call may run several
-        batches (one per due bin); jobs whose clustering raised are
-        reported via ``self.failures``, not returned."""
+        in pop order per batch.  One call may run several batches (one
+        per due bin); jobs whose clustering raised are reported via
+        ``self.failures``, shed jobs via ``self.shed`` — never
+        returned."""
         now = self.clock() if now is None else now
         out = []
         for key in self._due(now, force):
-            cls, _acc = key
-            q = self._bins[key]
-            jobs = [q.popleft() for _ in range(min(len(q),
-                                                   self.config.b_max))]
-            full = len(jobs) >= self.config.b_max
-            trigger = "full" if full else "drain" if force else "linger"
-            out.extend(self._dispatch(jobs, cls, trigger, now))
+            b = self._bins[key]
+            jobs = self._pop_batch(b, now)
+            if not jobs:
+                continue  # the whole pop shed
+            # Label from the ACTUALLY-PACKED size: a bin that counted
+            # as full but shed down to a partial batch is a partial
+            # dispatch in the telemetry, not a 'full' one.
+            trigger = ("full" if len(jobs) >= self.config.b_max
+                       else "drain" if force else "linger")
+            out.extend(self._dispatch(jobs, key, trigger, now))
         return out
 
     def drain(self) -> list:
-        """Flush every queued job regardless of linger/fill state."""
+        """Flush every queued job regardless of linger/fill state
+        (expired jobs still shed rather than pack).  Emits a ``drain``
+        span so a service shutdown is visible in the trace."""
+        sid = self.tracer.begin_span("drain", pending=self.pending())
         out = []
         while self.pending():
             out.extend(self.step(force=True))
+        self.tracer.end_span(sid, done=len(out))
         return out

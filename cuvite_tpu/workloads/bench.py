@@ -154,6 +154,10 @@ def validate_record(rec: dict) -> list:
         # tax — tools/perf_regress.py gates jobs_per_s like-for-like
         # (same slab class, same B).
         problems.extend(_validate_batch_block(rec.get("batch")))
+        # Optional `serve` block (ISSUE 11): open-loop saturation runs
+        # against the serving queue — goodput at an arrival rate under
+        # a wait-p95 SLO, with the admission/shedding outcome rates.
+        problems.extend(_validate_serve_block(rec.get("serve")))
     return problems
 
 
@@ -193,6 +197,56 @@ def _validate_batch_block(batch) -> list:
         problems.append(
             f"batch.engine must be one of {BATCH_ENGINES}, "
             f"got {batch['engine']!r}")
+    return problems
+
+
+# Required keys of the optional `serve` bench block (schema v4 + ISSUE
+# 11): one open-loop load-generator run against the serving queue.
+# arrival_jobs_per_s — the OFFERED rate; goodput_jobs_per_s — jobs
+# actually completed per second of wall (the serving capacity number);
+# wait_p95_ms vs slo_ms — whether the queue-wait SLO held;
+# admission — whether admission control was on (the A/B axis of the
+# overload acceptance run); reject_rate / shed_rate — the fraction of
+# offered jobs terminally rejected (admission) or shed (deadline).
+# perf_regress gates goodput like-for-like (same b_max, admission,
+# SLO, job shape, engine).
+REQUIRED_SERVE_KEYS = ("b_max", "arrival_jobs_per_s", "goodput_jobs_per_s",
+                       "wait_p95_ms", "slo_ms", "admission", "reject_rate",
+                       "shed_rate")
+
+
+def _validate_serve_block(serve) -> list:
+    if serve is None:
+        return []
+    if not isinstance(serve, dict):
+        return [f"serve must be a dict, got {type(serve).__name__}"]
+    problems = [f"serve block missing key {k!r}"
+                for k in REQUIRED_SERVE_KEYS if k not in serve]
+    if problems:
+        return problems
+    if not isinstance(serve["b_max"], int) or serve["b_max"] < 1:
+        problems.append(
+            f"serve.b_max must be a positive int, got {serve['b_max']!r}")
+    for k in ("arrival_jobs_per_s", "goodput_jobs_per_s", "slo_ms"):
+        v = serve[k]
+        if not isinstance(v, (int, float)) or v <= 0:
+            problems.append(f"serve.{k} must be positive, got {v!r}")
+    w = serve["wait_p95_ms"]
+    if not isinstance(w, (int, float)) or w < 0:
+        problems.append(
+            f"serve.wait_p95_ms must be non-negative, got {w!r}")
+    if not isinstance(serve["admission"], bool):
+        problems.append(
+            f"serve.admission must be a bool, got {serve['admission']!r}")
+    for k in ("reject_rate", "shed_rate"):
+        v = serve[k]
+        if not isinstance(v, (int, float)) or not 0.0 <= v <= 1.0:
+            problems.append(
+                f"serve.{k} must be a fraction in [0, 1], got {v!r}")
+    if "engine" in serve and serve["engine"] not in BATCH_ENGINES:
+        problems.append(
+            f"serve.engine must be one of {BATCH_ENGINES}, "
+            f"got {serve['engine']!r}")
     return problems
 
 
@@ -575,6 +629,190 @@ def run_batch_bench(
     return rec
 
 
+def warm_serve_rungs(graphs, b_max: int, engine: str) -> tuple:
+    """Serve-path compile warm-up: ONE batch at every BATCH_SIZES rung
+    <= ``b_max`` with the job-set-pinned bucket geometry, because
+    open-loop arrivals dispatch PARTIAL batches (linger/drain) whose
+    padded size can be any rung.  Returns ``(slab_class, shape)`` for
+    pinning the server.  Shared by :func:`run_serve_bench` and
+    tools/serve_load.py so the rung policy cannot drift between them;
+    call under a CompileWatcher when the compiles should be recorded.
+    Raises when the job set straddles slab classes (the queue would
+    split it over several bins and the warm-up could not cover them)."""
+    from cuvite_tpu.core.batch import (
+        BATCH_SIZES,
+        batch_pad,
+        bucket_shape_for,
+        slab_class_of,
+    )
+    from cuvite_tpu.louvain.driver import louvain_many
+
+    # ServeConfig rounds b_max UP to a BATCH_SIZES rung; warm the
+    # ROUNDED ladder or a non-rung b_max (say 10 -> 16) would compile
+    # its full-bin program inside the guarded timed loop.
+    b_max = min(batch_pad(b_max), BATCH_SIZES[-1])
+    classes = {slab_class_of(g) for g in graphs}
+    if len(classes) != 1:
+        raise ValueError(
+            f"serve job set straddles slab classes {sorted(classes)}; "
+            "pick an edge count away from a pow2 boundary so the queue "
+            "serves one bin")
+    cls = classes.pop()
+    shape = bucket_shape_for(graphs) if engine == "bucketed" else None
+    for r in (r for r in BATCH_SIZES if r <= b_max):
+        louvain_many(graphs[:r], b_pad=r, slab_class=cls, engine=engine,
+                     bucket_shape=shape)
+    return cls, shape
+
+
+def run_serve_bench(
+    *,
+    rate: float,
+    b_max: int = 8,
+    edges: int = 1024,
+    n_jobs: int | None = None,
+    seed: int = 1,
+    slo_ms: float = 500.0,
+    admission: bool = True,
+    linger_ms: float = 20.0,
+    deadline_ms: float | None = None,
+    tenants: int = 1,
+    engine: str = "bucketed",
+    platform: str = "cpu",
+    budget_s: float = 420.0,
+    t_start: float | None = None,
+) -> dict:
+    """Open-loop serving bench (ISSUE 11): offer ``n_jobs``
+    deterministic synth graphs to a fresh ``LouvainServer`` at
+    ``rate`` jobs/s (scheduled arrival stamps, serve/loadgen.py), then
+    drain; the record carries the ``serve`` block (goodput at the
+    offered rate, queue-wait p95 vs the SLO, reject/shed outcome
+    rates).  ``admission=False`` is the overload A/B arm: same rate,
+    no intake bound — the run that shows unbounded queue-wait growth.
+
+    Compile discipline: the warm-up runs ONE batch at every
+    BATCH_SIZES rung <= ``b_max`` with the job-set-pinned bucket
+    geometry, because open-loop arrivals dispatch PARTIAL batches
+    (linger/drain) whose padded size can be any rung — unlike the
+    closed chunking of :func:`run_batch_bench`, where one rung
+    suffices.  The timed open loop then runs under the compile guard
+    like every other bench.
+    """
+    from cuvite_tpu.obs import (
+        NO_TRACE,
+        CompileWatcher,
+        FlightRecorder,
+        convergence_summary,
+    )
+    from cuvite_tpu.serve import AdmissionConfig, LouvainServer, ServeConfig
+    from cuvite_tpu.serve.loadgen import run_open_loop
+    from cuvite_tpu.utils.trace import Tracer, rss_high_water_mb
+    from cuvite_tpu.workloads.synth import many_seed, synthesize_graph
+
+    from cuvite_tpu.core.batch import BATCH_SIZES, batch_pad
+
+    t_start = _T_PROC if t_start is None else t_start
+    if rate <= 0:
+        raise ValueError(f"--serve-rate must be > 0 jobs/s, got {rate}")
+    if engine not in BATCH_ENGINES:
+        raise ValueError(f"serve engine must be one of {BATCH_ENGINES}, "
+                         f"got {engine!r}")
+    # Round to the rung ServeConfig will serve at, so the record's
+    # serve.b_max matches the queue's actual batch cap.
+    b_max = min(batch_pad(int(b_max)), BATCH_SIZES[-1])
+    if n_jobs is None:
+        n_jobs = max(4 * b_max, 32)
+    graphs = [synthesize_graph(edges, seed=many_seed(seed, k))
+              for k in range(n_jobs)]
+    frec = FlightRecorder(NO_TRACE, watch_compiles=False)
+
+    # Warm-up: every rung a partial batch can pad to, one batch each,
+    # geometry pinned over the whole job set (the shared helper keeps
+    # this policy in lockstep with tools/serve_load.py).
+    with CompileWatcher(on_event=frec._on_compile):
+        cls, shape = warm_serve_rungs(graphs, b_max, engine)
+    elapsed = time.perf_counter() - t_start
+    if elapsed > budget_s:
+        raise RuntimeError(
+            f"serve bench warm-up alone spent {elapsed:.0f}s of the "
+            f"{budget_s:.0f}s budget; shrink --serve-b-max/--batch-edges")
+
+    config = ServeConfig(
+        b_max=b_max, linger_s=linger_ms / 1e3, engine=engine,
+        admission=(AdmissionConfig(wait_slo_s=slo_ms / 1e3)
+                   if admission else None))
+    tr = Tracer(recorder=frec)
+    server = LouvainServer(config, tracer=tr)
+    if shape is not None:
+        server.pin_shape(cls, shape)
+    with CompileWatcher(on_event=frec._on_compile) as watch:
+        rep = run_open_loop(
+            server, graphs, rate, tenants=tenants,
+            deadline_s=(deadline_ms / 1e3 if deadline_ms is not None
+                        else None),
+            max_wall_s=max(budget_s - elapsed, 30.0))
+    if watch.compiles:
+        raise BenchCompileGuardError(watch.compiles)
+    if not rep.results:
+        raise RuntimeError(
+            "serve bench completed no jobs (everything rejected/shed); "
+            "the record would carry no throughput — lower --serve-rate")
+    if not rep.conservation["ok"]:
+        raise RuntimeError(
+            f"job-conservation violation: {rep.conservation}")
+
+    results = [r for _, r in rep.results]
+    traversed = sum(p.num_edges * p.iterations
+                    for r in results for p in r.phases)
+    teps = traversed / max(rep.wall_s, 1e-9)
+    qs = [float(r.modularity) for r in results]
+    print(f"# serve: rate={rate:.1f}/s goodput="
+          f"{rep.goodput_jobs_per_s:.1f}/s wait_p95="
+          f"{rep.wait_p95_s * 1e3:.0f}ms (slo {slo_ms:.0f}ms) "
+          f"rejected={rep.rejected} shed={rep.shed}", file=sys.stderr)
+    return {
+        "metric": "louvain_teps_per_chip",
+        "value": round(teps, 1),
+        "unit": "traversed_edges/sec",
+        "vs_baseline": round(teps / BASELINE_EDGES_PER_SEC_PER_CHIP, 4),
+        "platform": platform,
+        "graph": f"synthpl-{edges}x{n_jobs}-serve",
+        "modularity": round(sum(qs) / len(qs), 6),
+        "phases": sum(len(r.phases) for r in results),
+        "iterations": sum(int(r.total_iterations) for r in results),
+        "rss_mb": round(rss_high_water_mb(), 1),
+        "compile_guard": {"checked": True, "new_compiles": 0},
+        "stages": tr.breakdown(),
+        "engine": "batched",
+        "schema": BENCH_SCHEMA_VERSION,
+        "convergence_summary": convergence_summary(
+            getattr(results[0], "convergence", None)),
+        "compile_events": [dict(e) for e in frec.compile_events],
+        "hbm_peak_by_buffer": dict(frec.ledger.peak_by_buffer),
+        "serve": {
+            "b_max": int(b_max),
+            "engine": engine,
+            "arrival_jobs_per_s": round(rate, 3),
+            "goodput_jobs_per_s": round(rep.goodput_jobs_per_s, 3),
+            "wait_p50_ms": round(rep.wait_p50_s * 1e3, 3),
+            "wait_p95_ms": round(rep.wait_p95_s * 1e3, 3),
+            "slo_ms": float(slo_ms),
+            "slo_met": bool(rep.wait_p95_s * 1e3 <= slo_ms),
+            "admission": bool(admission),
+            "reject_rate": round(rep.reject_rate, 4),
+            "shed_rate": round(rep.shed_rate, 4),
+            "offered": int(rep.offered),
+            "done": int(rep.done),
+            "rejected": int(rep.rejected),
+            "shed": int(rep.shed),
+            "failed": int(rep.failed),
+            "edges_each": int(edges),
+            "linger_ms": float(linger_ms),
+            "wall_s": round(rep.wall_s, 3),
+        },
+    }
+
+
 def _build_parser() -> argparse.ArgumentParser:
     env = os.environ
     p = argparse.ArgumentParser(
@@ -622,11 +860,79 @@ def _build_parser() -> argparse.ArgumentParser:
     b.add_argument("--host-devices", type=int, default=8,
                    help="virtual CPU devices to shard the batch axis "
                         "over (batch mode, cpu platform only)")
+    s = p.add_argument_group("open-loop serving bench (ISSUE 11)")
+    s.add_argument("--serve-rate", type=float, metavar="JOBS_PER_S",
+                   default=float(env["BENCH_SERVE_RATE"])
+                   if "BENCH_SERVE_RATE" in env else None,
+                   help="offer synth jobs to the serving queue at this "
+                        "open-loop arrival rate; the record carries the "
+                        "`serve` block (goodput, wait_p95 vs SLO, "
+                        "reject/shed rates).  Uses --batch-edges / "
+                        "--batch-engine / --batch-jobs for the job set")
+    s.add_argument("--serve-b-max", type=int, default=8,
+                   help="serving queue b_max (BATCH_SIZES rung)")
+    s.add_argument("--serve-slo-ms", type=float, default=500.0,
+                   help="queue-wait p95 SLO the admission controller "
+                        "defends")
+    s.add_argument("--serve-admission", default="on", choices=["on", "off"],
+                   help="'off' is the overload A/B arm: no intake bound, "
+                        "queue waits free to grow past the SLO")
+    s.add_argument("--serve-linger-ms", type=float, default=20.0)
+    s.add_argument("--serve-deadline-ms", type=float, default=None,
+                   help="attach a relative deadline to every job "
+                        "(exercises shedding)")
+    s.add_argument("--serve-tenants", type=int, default=1,
+                   help="spread jobs round-robin over N tenant ids")
     return p
 
 
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
+
+    if args.serve_rate is not None:
+        if args.batch is not None:
+            print("# --serve-rate and --batch are different benches; "
+                  "pick one", file=sys.stderr)
+            return 2
+        if args.file or args.scale is not None:
+            print("# --serve-rate is the synthetic serving bench: "
+                  "--file/--scale do not apply", file=sys.stderr)
+            return 2
+        from cuvite_tpu.utils.envknob import request_host_devices
+
+        request_host_devices(args.host_devices)
+        from cuvite_tpu.utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache()
+        platform = _init_backend()
+        try:
+            rec = run_serve_bench(
+                rate=args.serve_rate, b_max=args.serve_b_max,
+                edges=args.batch_edges, n_jobs=args.batch_jobs,
+                slo_ms=args.serve_slo_ms,
+                admission=args.serve_admission == "on",
+                linger_ms=args.serve_linger_ms,
+                deadline_ms=args.serve_deadline_ms,
+                tenants=args.serve_tenants,
+                engine=args.batch_engine, platform=platform,
+                budget_s=args.budget,
+            )
+        except BenchCompileGuardError as e:
+            print(f"# BENCH ABORTED: {e}", file=sys.stderr)
+            for line in e.compile_log:
+                print(f"#   {line[:200]}", file=sys.stderr)
+            return 3
+        problems = validate_record(rec)
+        if problems:
+            print(f"# BENCH ABORTED: invalid record: {problems}",
+                  file=sys.stderr)
+            return 4
+        line = json.dumps(rec)
+        print(line)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(line + "\n")
+        return 0
 
     if args.batch is not None:
         if args.batch < 1:

@@ -469,6 +469,34 @@ def justified(jobs, nv_pad):
 """,
         "cuvite_tpu/serve/fake_r015.py",
     ),
+    (
+        "R016",
+        """
+import time
+
+def due(queue, linger_s):
+    now = time.monotonic()        # untestable-deadline trap
+    stamp = time.time()           # ditto (wall time)
+    return [j for j in queue if now - j.t_submit >= linger_s], stamp
+""",
+        """
+import time
+
+from cuvite_tpu.serve import clock as serve_clock
+
+def due(queue, linger_s, clock=serve_clock.monotonic):
+    # deadlines run on the INJECTED clock; a bare default REFERENCE to
+    # time.monotonic is not a call and stays legal
+    t0 = time.perf_counter()      # busy timing: allowlisted
+    out = [j for j in queue if clock() - j.t_submit >= linger_s]
+    busy = time.perf_counter() - t0
+    return out, busy
+
+def injected_default(clock=time.monotonic):
+    return [clock()]
+""",
+        "cuvite_tpu/serve/fake_r016.py",
+    ),
 ]
 
 RULE_IDS = [c[0] for c in RULE_CASES]

@@ -254,11 +254,37 @@ def test_accumulator_classes_bin_separately(small_graphs):
 
 
 def test_b_max_rounds_to_ladder_rung():
-    assert ServeConfig(b_max=10).b_max == 16
-    assert ServeConfig(b_max=64).b_max == 64
-    assert ServeConfig(b_max=1000).b_max == 64
+    # ISSUE 11 satellite: the clamp is no longer silent — rounding to a
+    # rung warns (a clamped b_max=1000 serving 64-row batches would
+    # otherwise mislead capacity planning); exact rungs stay quiet.
+    with pytest.warns(UserWarning, match="BATCH_SIZES rung"):
+        assert ServeConfig(b_max=10).b_max == 16
+    with pytest.warns(UserWarning, match="BATCH_SIZES rung"):
+        assert ServeConfig(b_max=1000).b_max == 64
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        assert ServeConfig(b_max=64).b_max == 64
     with pytest.raises(ValueError):
         ServeConfig(b_max=0)
+
+
+def test_config_validates_at_config_time():
+    """ISSUE 11 satellite: linger/threshold/retry knobs refuse at
+    ServeConfig construction, not deep in the driver mid-dispatch."""
+    with pytest.raises(ValueError, match="linger_s"):
+        ServeConfig(linger_s=-0.1)
+    with pytest.raises(ValueError, match="threshold"):
+        ServeConfig(threshold=0.0)
+    with pytest.raises(ValueError, match="threshold"):
+        ServeConfig(threshold=-1e-6)
+    with pytest.raises(ValueError, match="max_retries"):
+        ServeConfig(max_retries=-1)
+    with pytest.raises(ValueError, match="retry_base_s"):
+        ServeConfig(retry_base_s=-0.5)
+    with pytest.raises(ValueError, match="admission"):
+        ServeConfig(admission="please")
 
 
 # ---------------------------------------------------------------------------
@@ -409,6 +435,105 @@ def test_perf_regress_separates_batch_engines(tmp_path, batch_record):
     peer["batch"]["jobs_per_s"] = \
         batch_record["batch"]["jobs_per_s"] * 100
     out = _gate(tmp_path, batch_record, peer)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 comparable" in out.stdout
+
+
+# ---------------------------------------------------------------------------
+# `serve` bench block (open-loop load generator) + perf_regress gate
+
+
+@pytest.fixture(scope="module")
+def serve_record():
+    import time as _time
+
+    from cuvite_tpu.workloads.bench import run_serve_bench
+
+    return run_serve_bench(
+        rate=200.0, b_max=2, edges=512, n_jobs=4, slo_ms=60000.0,
+        admission=True, linger_ms=1.0, budget_s=600.0, platform="cpu",
+        t_start=_time.perf_counter())
+
+
+def test_serve_record_schema_valid(serve_record):
+    assert validate_record(serve_record) == []
+    blk = serve_record["serve"]
+    assert blk["b_max"] == 2 and blk["offered"] == 4
+    assert blk["done"] == 4 and blk["rejected"] == 0
+    assert blk["goodput_jobs_per_s"] > 0
+    assert blk["admission"] is True and blk["slo_met"] is True
+    assert blk["reject_rate"] == 0.0 and blk["shed_rate"] == 0.0
+    assert serve_record["engine"] == "batched"
+    assert serve_record["compile_guard"] == {"checked": True,
+                                             "new_compiles": 0}
+
+
+def test_serve_block_validation_rejects_malformed(serve_record):
+    rec = json.loads(json.dumps(serve_record))
+    rec["serve"] = {"b_max": 2}
+    assert any("goodput_jobs_per_s" in p for p in validate_record(rec))
+    rec["serve"] = dict(serve_record["serve"], reject_rate=1.5)
+    assert any("reject_rate" in p for p in validate_record(rec))
+    rec["serve"] = dict(serve_record["serve"], admission="yes")
+    assert any("admission" in p for p in validate_record(rec))
+    rec["serve"] = dict(serve_record["serve"], goodput_jobs_per_s=0)
+    assert any("goodput_jobs_per_s" in p for p in validate_record(rec))
+    rec["serve"] = dict(serve_record["serve"], engine="sorted")
+    assert any("serve.engine" in p for p in validate_record(rec))
+
+
+def test_perf_regress_gates_serve_goodput(tmp_path, serve_record):
+    peer = json.loads(json.dumps(serve_record))
+    peer["serve"]["goodput_jobs_per_s"] = \
+        serve_record["serve"]["goodput_jobs_per_s"] * 2
+    out = _gate(tmp_path, serve_record, peer)
+    assert out.returncode == 1, out.stdout + out.stderr
+    assert "serve goodput_jobs_per_s" in out.stderr
+
+
+def test_perf_regress_serve_like_for_like(tmp_path, serve_record):
+    out = _gate(tmp_path, serve_record, json.loads(
+        json.dumps(serve_record)))
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_perf_regress_separates_admission_arms(tmp_path, serve_record):
+    """The admission-off overload arm is a DIFFERENT experiment (its
+    goodput can be much higher or lower at the same rate); it must
+    never gate the admission-on trajectory."""
+    peer = json.loads(json.dumps(serve_record))
+    peer["serve"]["admission"] = False
+    peer["serve"]["goodput_jobs_per_s"] = \
+        serve_record["serve"]["goodput_jobs_per_s"] * 100
+    out = _gate(tmp_path, serve_record, peer)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 comparable" in out.stdout
+
+
+def test_perf_regress_ignores_subsaturation_serve_runs(tmp_path,
+                                                       serve_record):
+    """Below saturation, goodput (and the rate-paced TEPS value) track
+    the OFFERED rate, not server capacity: a conservative low-rate run
+    must not trip against a saturated round's numbers."""
+    fresh = json.loads(json.dumps(serve_record))
+    fresh["serve"]["arrival_jobs_per_s"] = 10.0
+    fresh["serve"]["goodput_jobs_per_s"] = 9.8   # ~= offered: unsaturated
+    fresh["value"] = 1.0                         # rate-paced wall
+    peer = json.loads(json.dumps(serve_record))
+    peer["serve"]["goodput_jobs_per_s"] = \
+        serve_record["serve"]["goodput_jobs_per_s"] * 100
+    peer["value"] = serve_record["value"] * 100
+    out = _gate(tmp_path, fresh, peer)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_perf_regress_serve_vs_batch_never_compare(tmp_path, serve_record,
+                                                   batch_record):
+    """A serve record and a batch record are different benches: the
+    batch trajectory must not gate a fresh serve record."""
+    peer = json.loads(json.dumps(batch_record))
+    peer["value"] = serve_record["value"] * 100
+    out = _gate(tmp_path, serve_record, peer)
     assert out.returncode == 0, out.stdout + out.stderr
     assert "0 comparable" in out.stdout
 

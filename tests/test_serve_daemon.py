@@ -1,0 +1,357 @@
+"""Async daemon tests (ISSUE 11): socket intake, result routing,
+stats polling, rejection/shed notifications, graceful drain — and the
+subprocess SIGTERM acceptance check (daemon exits 0 with a clean drain
+and a serve_summary under an injected fault plan).
+
+In-process daemons run a STUB runner over a unix socket (jax never
+dispatches), so the protocol/threading machinery is tested in
+milliseconds; the one subprocess test exercises the real CLI + signal
+path end to end on tiny graphs.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import types
+
+import numpy as np
+import pytest
+
+from cuvite_tpu.serve import (
+    AdmissionConfig,
+    FaultPlan,
+    LouvainServer,
+    ServeConfig,
+    ServeDaemon,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def stub_runner(graphs, **kw):
+    results = []
+    for g in graphs:
+        nv = g.num_vertices
+        key = int(np.sum(g.tails)) % 997
+        results.append(types.SimpleNamespace(
+            communities=(np.arange(nv) + key) % max(nv, 1),
+            modularity=key / 997.0, phases=[1], total_iterations=3,
+            num_communities=nv))
+    return types.SimpleNamespace(results=results, n_phases=1)
+
+
+class DaemonClient:
+    """Minimal line-protocol client for the tests."""
+
+    def __init__(self, sock_path):
+        self.conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.conn.connect(sock_path)
+        self.conn.settimeout(30.0)
+        self.lines = self.conn.makefile("r", encoding="utf-8")
+        self.pending: list = []
+
+    def send(self, req: dict) -> None:
+        self.conn.sendall((json.dumps(req) + "\n").encode())
+
+    def _raw(self) -> dict:
+        line = self.lines.readline()
+        assert line, "daemon closed the connection unexpectedly"
+        return json.loads(line)
+
+    def recv(self) -> dict:
+        """Next ASYNC message (result/failed/shed/summary); request
+        replies interleave on the same stream and are buffered by
+        call()."""
+        if self.pending:
+            return self.pending.pop(0)
+        return self._raw()
+
+    def call(self, req: dict) -> dict:
+        """Send a request and return ITS reply (an 'ok'-keyed line),
+        buffering any async result lines that arrive first."""
+        self.send(req)
+        while True:
+            msg = self._raw()
+            if "ok" in msg:
+                return msg
+            self.pending.append(msg)
+
+    def close(self) -> None:
+        try:
+            self.conn.close()
+        except OSError:
+            pass
+
+
+def graph_req(seed: int, nv: int = 12, ne: int = 24, **extra) -> dict:
+    rng = np.random.default_rng(seed)
+    return dict({"op": "submit", "graph": {
+        "nv": nv,
+        "src": [int(x) for x in rng.integers(0, nv, ne)],
+        "dst": [int(x) for x in rng.integers(0, nv, ne)],
+        "w": None}}, **extra)
+
+
+@pytest.fixture
+def daemon(tmp_path):
+    srv = LouvainServer(
+        ServeConfig(b_max=2, linger_s=0.01, engine="fused"),
+        runner=stub_runner)
+    d = ServeDaemon(srv, sock_path=str(tmp_path / "serve.sock"),
+                    poll_s=0.005)
+    d.start()
+    yield d
+    if not d._done.is_set():
+        d.request_drain()
+        d.serve_forever(timeout=30.0)
+
+
+def test_daemon_submit_and_result_roundtrip(daemon, tmp_path):
+    c = DaemonClient(str(tmp_path / "serve.sock"))
+    try:
+        ack = c.call(graph_req(1, labels=True))
+        assert ack["ok"] and ack["job_id"]
+        ack2 = c.call(graph_req(2))
+        assert ack2["ok"]
+        got = {}
+        for _ in range(2):
+            msg = c.recv()
+            assert "result" in msg, msg
+            got[msg["result"]["job_id"]] = msg["result"]
+        assert set(got) == {ack["job_id"], ack2["job_id"]}
+        # labels only where asked for
+        assert "labels" in got[ack["job_id"]]
+        assert "labels" not in got[ack2["job_id"]]
+        assert len(got[ack["job_id"]]["labels"]) == 12
+        # stats poll from the reader thread while the dispatcher lives
+        st = c.call({"op": "stats"})
+        assert st["ok"] and st["stats"]["jobs_done"] == 2
+        assert st["conservation"]["ok"]
+    finally:
+        c.close()
+
+
+def test_daemon_bad_requests_answered_not_fatal(daemon, tmp_path):
+    c = DaemonClient(str(tmp_path / "serve.sock"))
+    try:
+        assert not c.call({"op": "explode"})["ok"]
+        assert not c.call({"op": "submit"})["ok"]       # no graph spec
+        c.conn.sendall(b"this is not json\n")
+        assert "bad json" in c.recv()["error"]
+        # the server-generated id namespace is reserved (a client
+        # squatting on 'job-N' would collide with a future auto id and
+        # overwrite its route)
+        r = c.call(dict(graph_req(9), id="job-7"))
+        assert not r["ok"] and "reserved" in r["error"]
+        ack = c.call(graph_req(3))                      # still serving
+        assert ack["ok"]
+        assert "result" in c.recv()
+    finally:
+        c.close()
+
+
+def test_daemon_line_cap_drops_flooder(tmp_path):
+    """A newline-free byte flood must drop THAT connection (error +
+    close), not grow the read buffer until the daemon OOMs."""
+    srv = LouvainServer(ServeConfig(b_max=2, linger_s=0.01,
+                                    engine="fused"), runner=stub_runner)
+    d = ServeDaemon(srv, sock_path=str(tmp_path / "f.sock"),
+                    poll_s=0.005, max_line_bytes=1024)
+    d.start()
+    c = DaemonClient(str(tmp_path / "f.sock"))
+    try:
+        c.conn.sendall(b"x" * 5000)          # no newline, over the cap
+        line = c.lines.readline()
+        assert "exceeds" in json.loads(line)["error"]
+        assert c.lines.readline() == ""       # connection closed
+        # the daemon itself is unharmed: a new client still serves
+        c2 = DaemonClient(str(tmp_path / "f.sock"))
+        try:
+            assert c2.call(graph_req(5))["ok"]
+            assert "result" in c2.recv()
+        finally:
+            c2.close()
+    finally:
+        c.close()
+        d.request_drain()
+        d.serve_forever(timeout=30.0)
+
+
+def test_daemon_rejection_and_shed_notifications(tmp_path):
+    srv = LouvainServer(
+        ServeConfig(b_max=2, linger_s=10.0, engine="fused",
+                    admission=AdmissionConfig(wait_slo_s=0.01)),
+        runner=stub_runner)
+    d = ServeDaemon(srv, sock_path=str(tmp_path / "s.sock"), poll_s=0.005)
+    # Pre-seed a fat service-time estimate so the projection rejects
+    # as soon as anything queues.
+    d.start()
+    c = DaemonClient(str(tmp_path / "s.sock"))
+    try:
+        ack = c.call(graph_req(1))
+        assert ack["ok"]
+        # Force a rejection decision (queue-level projection arithmetic
+        # is pinned in test_serve_robust; here the target is the wire
+        # mapping): decide() returning a retry_after_s rejects.
+        orig_decide = srv.admission.decide
+        with d.lock:
+            srv.admission.decide = lambda *a, **kw: 0.8
+        rej = c.call(graph_req(2))
+        assert rej["ok"] is False and rej["rejected"] is True
+        assert rej["retry_after_s"] == pytest.approx(0.8)
+        with d.lock:   # back to normal so the next submit admits
+            srv.admission.decide = orig_decide
+        # a job with an already-hopeless deadline sheds, with a notice
+        ack3 = c.call(dict(graph_req(3), deadline_s=-0.001))
+        assert ack3["ok"]
+        msgs = [c.recv() for _ in range(2)]
+        kinds = {next(iter(m)) for m in msgs}
+        assert kinds == {"result", "shed"}
+    finally:
+        c.close()
+        d.request_drain()
+        d.serve_forever(timeout=30.0)
+
+
+def test_daemon_graceful_drain_summary(daemon, tmp_path):
+    c = DaemonClient(str(tmp_path / "serve.sock"))
+    try:
+        acks = [c.call(graph_req(10 + s)) for s in range(5)]
+        assert all(a["ok"] for a in acks)
+        r = c.call({"op": "drain"})
+        assert r["ok"] and r["draining"]
+        msgs = []
+        while True:
+            msg = c.recv()
+            msgs.append(msg)
+            if "serve_summary" in msg:
+                break
+        summary = msgs[-1]["serve_summary"]
+        results = [m for m in msgs if "result" in m]
+        assert len(results) == 5, msgs
+        assert summary["jobs_done"] == 5
+        assert summary["conservation"]["ok"]
+        # post-drain submits are refused
+        final = daemon.serve_forever(timeout=30.0)
+        assert final["jobs_done"] == 5
+    finally:
+        c.close()
+
+
+def test_daemon_refuses_submit_while_draining(daemon, tmp_path):
+    c = DaemonClient(str(tmp_path / "serve.sock"))
+    try:
+        daemon.request_drain()
+        daemon.serve_forever(timeout=30.0)
+        # The daemon has fully drained; a late submit on a still-open
+        # connection gets the draining refusal (connection may also be
+        # closed already — both are clean outcomes).
+        try:
+            resp = c.call(graph_req(99))
+        except (AssertionError, OSError):
+            return
+        assert resp["ok"] is False and resp.get("draining")
+    finally:
+        c.close()
+
+
+# ---------------------------------------------------------------------------
+# THE subprocess acceptance check: real CLI, real signal, real jax —
+# SIGTERM mid-stream must drain cleanly and exit 0, fault plan active.
+
+
+def test_daemon_sigterm_clean_drain_subprocess(tmp_path):
+    sock = str(tmp_path / "d.sock")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               CUVITE_FAULT_PLAN="device:transient:n=1")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "cuvite_tpu.serve", "daemon",
+         "--socket", sock, "--b-max", "2", "--linger-ms", "5",
+         "--host-devices", "1", "--max-retries", "2",
+         "--retry-base-ms", "1"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env=env)
+    try:
+        ready = json.loads(proc.stdout.readline())
+        assert ready["ready"]["socket"] == sock
+        assert ready["ready"]["fault_plan"] == "device:transient:n=1"
+        c = DaemonClient(sock)
+        try:
+            acks = [c.call({"op": "submit",
+                            "synth": {"edges": 256, "seed": 40 + s},
+                            "tenant": f"t{s % 2}"})
+                    for s in range(4)]
+            assert all(a["ok"] for a in acks), acks
+            # SIGTERM with jobs possibly still queued/running: the
+            # daemon must drain them and exit 0.
+            proc.send_signal(signal.SIGTERM)
+            seen = []
+            while True:
+                msg = c.recv()
+                seen.append(msg)
+                if "serve_summary" in msg:
+                    break
+            summary = msg["serve_summary"]
+        finally:
+            c.close()
+        rc = proc.wait(timeout=120)
+        assert rc == 0, proc.stderr.read()[-2000:]
+        assert summary["jobs_done"] == 4
+        assert summary["jobs_failed"] == 0
+        assert summary["retries"] >= 1, \
+            "the injected transient fault should have retried"
+        assert summary["conservation"]["ok"]
+        results = [m for m in seen if "result" in m]
+        assert len(results) == 4
+        # The CLI prints the same summary as its last stdout line.
+        out_lines = proc.stdout.read().strip().splitlines()
+        assert json.loads(out_lines[-1])["serve_summary"]["jobs_done"] == 4
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+def test_daemon_config_errors_exit_2():
+    out = subprocess.run(
+        [sys.executable, "-m", "cuvite_tpu.serve", "daemon",
+         "--socket", "/tmp/x.sock", "--port", "7",
+         "--host-devices", "1"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert out.returncode == 2
+    out = subprocess.run(
+        [sys.executable, "-m", "cuvite_tpu.serve", "daemon",
+         "--host-devices", "1"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert out.returncode == 2
+    out = subprocess.run(
+        [sys.executable, "-m", "cuvite_tpu.serve", "daemon",
+         "--socket", "/tmp/x.sock", "--fault-plan", "bogus:nope",
+         "--host-devices", "1"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert out.returncode == 2
+    assert "fault directive" in out.stderr
+
+
+def test_daemon_wait_helpers(tmp_path):
+    """serve_forever times out rather than hanging when no drain was
+    requested; a second start() is not required for the drain path."""
+    srv = LouvainServer(ServeConfig(b_max=2, linger_s=0.01,
+                                    engine="fused"), runner=stub_runner)
+    d = ServeDaemon(srv, sock_path=str(tmp_path / "w.sock"), poll_s=0.005)
+    d.start()
+    with pytest.raises(TimeoutError):
+        d.serve_forever(timeout=0.2)
+    t0 = time.perf_counter()
+    d.request_drain()
+    summary = d.serve_forever(timeout=30.0)
+    assert summary["jobs_done"] == 0
+    assert time.perf_counter() - t0 < 30.0
+    with pytest.raises(ValueError):
+        ServeDaemon(srv)            # neither socket nor port
+    with pytest.raises(ValueError):
+        ServeDaemon(srv, sock_path="x", port=5)

@@ -1,0 +1,656 @@
+"""Robustness-layer tests (ISSUE 11): fault injection + retry,
+admission control, per-tenant fairness, deadline shedding, the
+open-loop load generator, and the chaos gate (job conservation under a
+seeded randomized fault plan over hundreds of jobs).
+
+Everything except the explicitly-real-jax tests runs a STUB runner on
+a fake clock/sleep pair: the invariants under test (conservation,
+retry accounting, fairness, admission projections) live entirely in
+the queue, so hundreds of chaos jobs cost milliseconds and zero
+sleeps.  The real-jax tests then pin the one property the stub cannot:
+surviving tenants' labels/Q bit-identical to a fault-free run through
+the real batched driver.
+"""
+
+import types
+
+import numpy as np
+import pytest
+
+from cuvite_tpu.core.graph import Graph
+from cuvite_tpu.serve import (
+    AdmissionConfig,
+    AdmissionReject,
+    FaultPlan,
+    InjectedFault,
+    LouvainServer,
+    ServeConfig,
+)
+from cuvite_tpu.serve.faults import FaultRule
+from cuvite_tpu.serve.loadgen import run_open_loop, saturation_sweep
+from cuvite_tpu.serve.queue import _ClassBin, Job
+from cuvite_tpu.workloads.synth import many_seed, synthesize_graph
+
+
+class FakeClock:
+    """Injectable clock + sleep pair: sleep advances virtual time."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, s: float) -> None:
+        self.t += s
+
+
+def make_graph(seed: int, nv: int = 16, ne: int = 32) -> Graph:
+    rng = np.random.default_rng(seed)
+    return Graph.from_edges(nv, rng.integers(0, nv, ne),
+                            rng.integers(0, nv, ne))
+
+
+def stub_result(g):
+    """Deterministic pure function of the graph — the identity anchor
+    for chaos runs (a fault that perturbed a surviving job's inputs
+    would change this)."""
+    nv = g.num_vertices
+    key = int(np.sum(g.tails)) % 997
+    return types.SimpleNamespace(
+        communities=(np.arange(nv) + key) % max(nv, 1),
+        modularity=key / 997.0,
+        phases=[1], total_iterations=3, num_communities=nv)
+
+
+def make_stub_runner(clock=None, service_s: float = 0.0, calls=None):
+    """cluster_many-shaped stub; optionally consumes virtual service
+    time per batch (what makes queueing/admission observable on the
+    fake clock)."""
+
+    def runner(graphs, **kw):
+        if calls is not None:
+            calls.append(len(graphs))
+        if clock is not None and service_s:
+            clock.sleep(service_s)
+        results = [stub_result(g) for g in graphs]
+        return types.SimpleNamespace(results=results, n_phases=1)
+
+    return runner
+
+
+def make_server(clock, *, runner=None, faults=None, **cfg_kw):
+    cfg_kw.setdefault("engine", "fused")  # stub path: skip plan shapes
+    cfg_kw.setdefault("b_max", 4)
+    cfg_kw.setdefault("linger_s", 0.0)
+    return LouvainServer(ServeConfig(**cfg_kw), clock=clock,
+                         sleep=clock.sleep, faults=faults,
+                         runner=runner or make_stub_runner(clock))
+
+
+# ---------------------------------------------------------------------------
+# Fault-plan grammar
+
+
+def test_fault_plan_grammar_round_trip():
+    plan = FaultPlan.parse(
+        "dispatch:raise:every=7; device:transient:n=2;"
+        "pack:transient:p=0.1,seed=42")
+    assert len(plan.rules) == 3
+    assert plan.rules[0].permanent and plan.rules[0].every == 7
+    assert not plan.rules[1].permanent and plan.rules[1].n == 2
+    assert plan.rules[2].p == 0.1 and plan.rules[2].seed == 42
+    assert FaultPlan.parse(plan.spec()).spec() == plan.spec()
+    assert not FaultPlan.parse("")       # empty plan is falsy
+    assert not FaultPlan.parse(None)
+
+
+@pytest.mark.parametrize("bad", [
+    "dispatch:raise",                    # no params
+    "teleport:raise:n=1",                # unknown site
+    "dispatch:crash:n=1",                # unknown kind
+    "dispatch:raise:n=0",                # selector out of range
+    "dispatch:raise:p=1.5",
+    "dispatch:raise:every=7,n=2",        # two selectors
+    "dispatch:raise:seed=3",             # no selector
+    "dispatch:raise:bogus=1",
+])
+def test_fault_plan_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        FaultPlan.parse(bad)
+
+
+def test_fault_plan_deterministic():
+    def fire_seq(plan, n=64):
+        out = []
+        for _ in range(n):
+            try:
+                plan.check("device")
+                out.append(0)
+            except InjectedFault:
+                out.append(1)
+        return out
+
+    spec = "device:transient:p=0.3,seed=9"
+    a = fire_seq(FaultPlan.parse(spec))
+    b = fire_seq(FaultPlan.parse(spec))
+    assert a == b and sum(a) > 0
+    every = fire_seq(FaultPlan.parse("device:raise:every=4"), 12)
+    assert every == [0, 0, 0, 1] * 3
+    first_n = fire_seq(FaultPlan.parse("device:transient:n=2"), 5)
+    assert first_n == [1, 1, 0, 0, 0]
+
+
+# ---------------------------------------------------------------------------
+# Transient retry + permanent isolation
+
+
+def test_transient_fault_retries_with_backoff():
+    clock = FakeClock()
+    calls = []
+    srv = make_server(clock, runner=make_stub_runner(clock, calls=calls),
+                      faults=FaultPlan.parse("device:transient:n=2"),
+                      max_retries=3, retry_base_s=0.1)
+    from cuvite_tpu.obs import FlightRecorder, MemoryTraceSink
+    from cuvite_tpu.utils.trace import Tracer
+
+    sink = MemoryTraceSink()
+    srv.tracer = Tracer(recorder=FlightRecorder(sink, watch_compiles=False))
+    jid = srv.submit(make_graph(0))
+    t_before = clock.t
+    done = srv.step(force=True)
+    assert [j for j, _ in done] == [jid]
+    assert srv.stats.retries == 2
+    # Exponential backoff on the injectable sleep: 0.1 + 0.2.
+    assert clock.t - t_before == pytest.approx(0.1 + 0.2)
+    retries = [r for r in sink.records
+               if r.get("t") == "event" and r.get("name") == "retry"]
+    assert [r["attrs"]["attempt"] for r in retries] == [1, 2]
+    assert retries[0]["attrs"]["site"] == "device"
+    assert srv.conservation()["ok"]
+
+
+def test_transient_exhausted_flows_to_failure():
+    clock = FakeClock()
+    srv = make_server(clock,
+                      faults=FaultPlan.parse("device:transient:n=99"),
+                      max_retries=1, retry_base_s=0.01)
+    srv.submit(make_graph(1))
+    assert srv.step(force=True) == []
+    assert srv.stats.retries == 1
+    assert srv.stats.jobs_failed == 1 and len(srv.failures) == 1
+    assert "transient" in srv.failures[0][1]
+    assert srv.conservation()["ok"]
+
+
+def test_permanent_batch_fault_isolates_batchmates():
+    """A permanent fault hitting a BATCH dispatch must not kill the
+    jobs: the batch splits and each isolated single-job dispatch (a
+    fresh passage through the fault sites) completes."""
+    clock = FakeClock()
+    srv = make_server(clock, faults=FaultPlan.parse("dispatch:raise:n=1"))
+    ids = [srv.submit(make_graph(s)) for s in range(3)]
+    done = dict(srv.step(force=True))
+    assert set(done) == set(ids)
+    assert srv.stats.jobs_failed == 0 and not srv.failures
+    assert srv.stats.jobs_done == 3
+    assert srv.conservation()["ok"]
+
+
+def test_submit_fault_counts_as_rejection():
+    clock = FakeClock()
+    srv = make_server(clock, faults=FaultPlan.parse("submit:raise:n=1"))
+    with pytest.raises(InjectedFault):
+        srv.submit(make_graph(0))
+    jid = srv.submit(make_graph(1))  # passage 2: admitted
+    assert srv.stats.jobs_rejected == 1 and srv.stats.jobs_submitted == 1
+    done = srv.step(force=True)
+    assert [j for j, _ in done] == [jid]
+    assert srv.conservation()["ok"]
+
+
+# ---------------------------------------------------------------------------
+# Admission control
+
+
+def test_admission_cold_start_admits():
+    clock = FakeClock()
+    srv = make_server(clock,
+                      admission=AdmissionConfig(wait_slo_s=0.001))
+    for s in range(8):
+        srv.submit(make_graph(s))   # no estimate yet: everything admits
+    assert srv.stats.jobs_rejected == 0 and srv.pending() == 8
+
+
+def test_admission_empty_bin_always_admits():
+    """A class whose batch service EXCEEDS slo/headroom must still
+    admit into an empty (sub-one-batch) bin: the job dispatches within
+    the linger window; its own batch service is not queue wait.
+    (Counting it would lock the class out at depth 0 forever.)"""
+    clock = FakeClock()
+    srv = make_server(clock,
+                      runner=make_stub_runner(clock, service_s=2.0),
+                      b_max=4, admission=AdmissionConfig(wait_slo_s=0.5))
+    srv.submit(make_graph(0))
+    srv.step(force=True)             # est ~2 s >> slo 0.5 s
+    assert srv.admission.estimate(next(iter(srv.admission._obs))) \
+        == pytest.approx(2.0)
+    for s in range(3):               # depths 0..2 < b_max: all admit
+        srv.submit(make_graph(10 + s))
+    assert srv.stats.jobs_rejected == 0 and srv.pending() == 3
+    with pytest.raises(AdmissionReject):
+        for s in range(8):           # one full batch queued: reject
+            srv.submit(make_graph(20 + s))
+    srv.drain()
+    assert srv.conservation()["ok"]
+
+
+def test_admission_rejects_with_retry_after():
+    """Once the measured service time projects a new job's wait past
+    the SLO, submit rejects with a structured retry_after_s."""
+    clock = FakeClock()
+    srv = make_server(clock,
+                      runner=make_stub_runner(clock, service_s=0.3),
+                      b_max=2, admission=AdmissionConfig(wait_slo_s=0.5))
+    from cuvite_tpu.obs import FlightRecorder, MemoryTraceSink
+    from cuvite_tpu.utils.trace import Tracer
+
+    sink = MemoryTraceSink()
+    srv.tracer = Tracer(recorder=FlightRecorder(sink, watch_compiles=False))
+    srv.submit(make_graph(0))
+    srv.submit(make_graph(1))
+    srv.step()                       # observes busy ~0.3 s per batch
+    est = srv.admission.estimate(next(iter(srv.admission._obs)))
+    assert est == pytest.approx(0.3)
+    # floor(depth/b_max) full batches stand between a new job and its
+    # own dispatch: depths 0-1 project 0 (admit — an empty-ish bin
+    # serves within linger regardless of batch service time), depths
+    # 2-3 project 1 * 0.3 * 1.25 = 0.375 <= 0.5 (admit), depth 4
+    # projects 0.75 s past the 0.5 s SLO: reject from there.
+    admitted = []
+    rejections = []
+    for s in range(6):
+        try:
+            admitted.append(srv.submit(make_graph(10 + s)))
+        except AdmissionReject as e:
+            rejections.append(e)
+    assert len(admitted) == 4
+    assert rejections, "overload must reject"
+    assert all(e.retry_after_s > 0 for e in rejections)
+    assert srv.stats.jobs_rejected == len(rejections)
+    rej_events = [r for r in sink.records
+                  if r.get("t") == "event" and r.get("name") == "reject"]
+    assert len(rej_events) == len(rejections)
+    assert rej_events[0]["attrs"]["retry_after_s"] > 0
+    srv.drain()
+    assert srv.conservation()["ok"]
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant fairness
+
+
+def test_class_bin_round_robin():
+    b = _ClassBin()
+    for k in range(4):
+        b.push(Job(f"a{k}", None, (0, 0), t_submit=float(k), tenant="A"))
+    b.push(Job("b0", None, (0, 0), t_submit=10.0, tenant="B"))
+    b.push(Job("c0", None, (0, 0), t_submit=11.0, tenant="C"))
+    assert b.depth() == 6
+    assert b.oldest_t_submit() == 0.0
+    order = [b.pop_rr().job_id for _ in range(6)]
+    assert order == ["a0", "b0", "c0", "a1", "a2", "a3"]
+    assert b.pop_rr() is None and b.depth() == 0
+
+
+def test_firehose_tenant_cannot_monopolize_batch():
+    """Tenant A floods the bin; tenant B's two jobs still ride the
+    FIRST batch (round-robin pop), not batch 4."""
+    clock = FakeClock()
+    srv = make_server(clock, b_max=4)
+    a_ids = [srv.submit(make_graph(s), tenant="firehose")
+             for s in range(6)]
+    b_ids = [srv.submit(make_graph(100 + s), tenant="small")
+             for s in range(2)]
+    first = [j for j, _ in srv.step()]     # full bin -> one batch of 4
+    assert first == [a_ids[0], b_ids[0], a_ids[1], b_ids[1]]
+    rest = [j for j, _ in srv.drain()]
+    assert rest == a_ids[2:]
+    assert srv.conservation()["ok"]
+
+
+def test_linger_reads_oldest_across_tenants():
+    """The firehose cannot hold the linger clock hostage: the deadline
+    runs from the OLDEST job in the bin even when a flood of newer
+    jobs arrives after it."""
+    clock = FakeClock()
+    srv = make_server(clock, b_max=64, linger_s=0.5)
+    old = srv.submit(make_graph(0), tenant="small")
+    clock.t += 0.4
+    for s in range(5):
+        srv.submit(make_graph(10 + s), tenant="firehose")
+    clock.t += 0.15                  # old job is 0.55 s old, flood 0.15 s
+    done = [j for j, _ in srv.step()]
+    assert old in done and len(done) == 6
+    assert srv.stats.linger_dispatches == 1
+
+
+# ---------------------------------------------------------------------------
+# Deadline shedding
+
+
+def test_expired_jobs_shed_not_packed():
+    clock = FakeClock()
+    calls = []
+    srv = make_server(clock, runner=make_stub_runner(clock, calls=calls))
+    from cuvite_tpu.obs import FlightRecorder, MemoryTraceSink
+    from cuvite_tpu.utils.trace import Tracer
+
+    sink = MemoryTraceSink()
+    srv.tracer = Tracer(recorder=FlightRecorder(sink, watch_compiles=False))
+    doomed = srv.submit(make_graph(0), deadline_s=0.1)
+    alive = srv.submit(make_graph(1), deadline_s=10.0)
+    clock.t += 0.2                   # doomed expires; alive does not
+    done = srv.step(force=True)
+    assert [j for j, _ in done] == [alive]
+    assert [j for j, _ in srv.shed] == [doomed]
+    assert srv.stats.jobs_shed == 1
+    assert calls == [1], "the shed job must never reach the runner"
+    shed_events = [r for r in sink.records
+                   if r.get("t") == "event" and r.get("name") == "shed"]
+    assert len(shed_events) == 1
+    assert shed_events[0]["attrs"]["job_id"] == doomed
+    assert shed_events[0]["attrs"]["late_s"] == pytest.approx(0.1)
+    assert srv.conservation()["ok"]
+
+
+def test_linger_fires_for_second_bin_after_long_dispatch():
+    """ISSUE 11 satellite: a bin whose linger deadline passes WHILE
+    another bin's batch is mid-dispatch is picked up by the next step
+    — the due-scan is a snapshot, not a lost wakeup."""
+    from cuvite_tpu.io.generate import generate_rmat
+
+    clock = FakeClock()
+    srv = make_server(clock, runner=make_stub_runner(clock, service_s=0.6),
+                      b_max=4, linger_s=0.5)
+    small = srv.submit(make_graph(0))
+    clock.t += 0.55                  # small class now past linger
+    big = srv.submit(generate_rmat(13, edge_factor=8, seed=1))
+    first = [j for j, _ in srv.step()]
+    # Only the small-class bin was due at the scan; its 0.6 s dispatch
+    # pushed the clock past the big job's linger deadline.
+    assert first == [small]
+    second = [j for j, _ in srv.step()]
+    assert second == [big]
+    assert srv.stats.linger_dispatches == 2
+    assert srv.conservation()["ok"]
+
+
+# ---------------------------------------------------------------------------
+# Stats thread-safety (ISSUE 11 satellite)
+
+
+def test_stats_snapshot_race_free():
+    """to_dict()/percentiles snapshot wait_samples under the lock: a
+    reader hammering them while a writer appends must never see a
+    mutating deque (pre-fix: sorted() over a deque being appended
+    raises RuntimeError)."""
+    import threading
+
+    from cuvite_tpu.serve import ServeStats
+
+    stats = ServeStats()
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                stats.to_dict()
+                _ = stats.wait_p95_s
+        except Exception as e:  # pragma: no cover - the failure signal
+            errors.append(e)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    for i in range(20000):
+        with stats.lock:
+            stats.wait_samples.append(i * 1e-6)
+            stats.jobs_done += 1
+    stop.set()
+    t.join(timeout=30)
+    assert not errors
+    assert stats.to_dict()["jobs_done"] == 20000
+
+
+# ---------------------------------------------------------------------------
+# Open-loop load generator (stub runner, fake clock)
+
+
+def _loadgen_server(clock, *, service_s, admission=None, b_max=4):
+    return make_server(clock,
+                       runner=make_stub_runner(clock, service_s=service_s),
+                       b_max=b_max, linger_s=0.05, admission=admission)
+
+
+def test_open_loop_sustainable_rate():
+    clock = FakeClock()
+    srv = _loadgen_server(clock, service_s=0.05)  # ~80 jobs/s capacity
+    graphs = [make_graph(s) for s in range(32)]
+    rep = run_open_loop(srv, graphs, rate=20.0)
+    assert rep.done == 32 and rep.rejected == 0 and rep.shed == 0
+    assert rep.goodput_jobs_per_s == pytest.approx(20.0, rel=0.3)
+    assert rep.wait_p95_s < 0.5
+    assert rep.conservation["ok"]
+
+
+def test_open_loop_overload_without_admission_grows_unbounded():
+    """The failure mode admission exists for: at ~3x capacity with no
+    intake bound, every job completes eventually but queue waits grow
+    with the backlog — wait_p95 far past any reasonable SLO."""
+    clock = FakeClock()
+    srv = _loadgen_server(clock, service_s=0.4, b_max=2)  # ~5 jobs/s
+    graphs = [make_graph(s) for s in range(48)]
+    rep = run_open_loop(srv, graphs, rate=15.0)
+    assert rep.done == 48 and rep.rejected == 0
+    assert rep.wait_p95_s > 2.0, \
+        f"overload should blow the queue wait, got {rep.wait_p95_s}"
+    assert rep.conservation["ok"]
+
+
+def test_open_loop_overload_with_admission_holds_slo():
+    """Same overload with admission on: excess jobs are rejected with
+    retry_after_s and the ADMITTED jobs' wait p95 stays within the
+    SLO the controller defends."""
+    slo_s = 1.0
+    clock = FakeClock()
+    srv = _loadgen_server(clock, service_s=0.4, b_max=2,
+                          admission=AdmissionConfig(wait_slo_s=slo_s))
+    graphs = [make_graph(s) for s in range(48)]
+    rep = run_open_loop(srv, graphs, rate=15.0)
+    assert rep.rejected > 0, "overload must shed load at intake"
+    assert rep.done == 48 - rep.rejected
+    assert rep.wait_p95_s <= slo_s * 1.5, \
+        (f"admission should bound waits near the SLO, got "
+         f"{rep.wait_p95_s}")
+    assert rep.conservation["ok"]
+
+
+def test_saturation_sweep_finds_knee():
+    def mk_server():
+        clock = FakeClock()
+        return _loadgen_server(clock, service_s=0.5, b_max=2,
+                               admission=AdmissionConfig(wait_slo_s=1.0))
+
+    graphs = [make_graph(s) for s in range(24)]
+    reports, best = saturation_sweep(
+        mk_server, lambda: graphs, start_rate=1.0, slo_s=1.0,
+        growth=2.0, max_rounds=6)
+    assert best is not None
+    assert len(reports) > 1
+    last = reports[-1]
+    # The ramp stopped because the last rate was unsustainable.
+    assert (last.rejected > 0 or last.wait_p95_s > 1.0
+            or last.goodput_jobs_per_s < 0.9 * last.rate)
+    assert best.rate < last.rate
+
+
+# ---------------------------------------------------------------------------
+# THE chaos gate (tier-1 acceptance): seeded randomized fault plan over
+# hundreds of jobs -> conservation + surviving-result identity.
+
+CHAOS_PLAN = (
+    "submit:transient:p=0.02,seed=11;"
+    "pack:transient:p=0.05,seed=12;"
+    "dispatch:raise:p=0.03,seed=13;"
+    "device:transient:p=0.08,seed=14;"
+    "device:raise:p=0.02,seed=15;"
+    "unpack:transient:p=0.04,seed=16"
+)
+
+
+def _chaos_run(n_jobs=240, faults=None, admission=None):
+    clock = FakeClock()
+    srv = make_server(
+        clock, runner=make_stub_runner(clock, service_s=0.05),
+        b_max=8, linger_s=0.1, max_retries=2, retry_base_s=0.01,
+        faults=faults, admission=admission)
+    if admission is not None:
+        # Seed the service-time estimate so intake pressure rejects
+        # deterministically from the first burst.
+        srv.submit(make_graph(10**6), job_id="warm")
+        srv.step(force=True)
+    outcomes = {}
+    results = {}
+    submitted = []
+    k = 0
+    while k < n_jobs:
+        for _ in range(6):           # burst arrivals between steps
+            if k >= n_jobs:
+                break
+            jid = f"j{k}"
+            deadline = 0.12 if k % 5 == 0 else None
+            try:
+                srv.submit(make_graph(k), job_id=jid,
+                           tenant=f"t{k % 7}", deadline_s=deadline)
+                submitted.append(jid)
+            except (AdmissionReject, InjectedFault):
+                outcomes[jid] = "rejected"
+            k += 1
+        for jid, res in srv.step():
+            assert jid not in outcomes, f"{jid} terminated twice"
+            outcomes[jid] = "done"
+            results[jid] = res
+        clock.t += 0.05
+    for jid, res in srv.drain():
+        assert jid not in outcomes, f"{jid} terminated twice"
+        outcomes[jid] = "done"
+        results[jid] = res
+    for jid, _err in srv.failures:
+        assert outcomes.setdefault(jid, "failed") == "failed", \
+            f"{jid} terminated twice"
+    for jid, _late in srv.shed:
+        assert outcomes.setdefault(jid, "shed") == "shed", \
+            f"{jid} terminated twice"
+    return srv, outcomes, results, submitted
+
+
+def test_chaos_conservation_and_identity():
+    faults = FaultPlan.parse(CHAOS_PLAN)
+    srv, outcomes, results, submitted = _chaos_run(
+        n_jobs=240, faults=faults,
+        admission=AdmissionConfig(wait_slo_s=0.6))
+    # Every injection site actually fired at least once — the plan
+    # covers the whole dispatch path, not a corner of it.
+    fired_sites = {r.site for r in faults.rules if r.fired}
+    assert fired_sites == {"submit", "pack", "dispatch", "device",
+                           "unpack"}, fired_sites
+    # Job conservation: every job terminated exactly once (the double-
+    # termination asserts live in _chaos_run) and the ledger balances.
+    cons = srv.conservation()
+    assert cons["ok"], cons
+    assert cons["pending"] == 0
+    n_jobs = 240
+    assert len(outcomes) == n_jobs, \
+        f"{n_jobs - len(outcomes)} jobs vanished"
+    by_kind = {k: sum(1 for v in outcomes.values() if v == k)
+               for k in ("done", "failed", "rejected", "shed")}
+    assert sum(by_kind.values()) == n_jobs
+    # The chaos actually exercised every terminal path.
+    assert all(by_kind[k] > 0 for k in by_kind), by_kind
+    assert srv.stats.retries > 0
+    # Surviving tenants bit-identical to a fault-free run: the same
+    # submissions through a no-fault no-admission server.
+    _, _, clean_results, _ = _chaos_run(n_jobs=240)
+    for jid, res in results.items():
+        ref = clean_results[jid]
+        assert res.modularity == ref.modularity
+        assert np.array_equal(res.communities, ref.communities), jid
+
+
+# ---------------------------------------------------------------------------
+# Real-jax fault runs: the stub cannot pin label/Q bit-identity through
+# the actual batched driver, so a small chaos run does.
+
+
+@pytest.fixture(scope="module")
+def real_graphs():
+    return [synthesize_graph(512, seed=many_seed(21, k)) for k in range(6)]
+
+
+def test_real_jax_faults_bit_identical_survivors(real_graphs):
+    """Transient + permanent faults through the REAL driver: retried /
+    isolated jobs return exactly the labels and Q of a fault-free
+    serve (the retry re-runs the same deterministic program)."""
+    clean = LouvainServer(ServeConfig(b_max=4, linger_s=0.0),
+                          clock=FakeClock())
+    clean_ids = [clean.submit(g) for g in real_graphs]
+    clean_done = dict(clean.drain())
+
+    clock = FakeClock()
+    faults = FaultPlan.parse(
+        "device:transient:n=1;dispatch:raise:every=3")
+    srv = LouvainServer(ServeConfig(b_max=4, linger_s=0.0,
+                                    max_retries=2, retry_base_s=0.01),
+                        clock=clock, sleep=clock.sleep, faults=faults)
+    ids = [srv.submit(g) for g in real_graphs]
+    done = dict(srv.drain())
+    assert srv.stats.retries >= 1
+    assert sum(r.fired for r in faults.rules) >= 2
+    assert srv.conservation()["ok"]
+    for cid, jid in zip(clean_ids, ids):
+        if jid not in done:
+            continue  # permanently failed by injection: terminal, fine
+        ref = clean_done[cid]
+        assert done[jid].modularity == ref.modularity
+        assert np.array_equal(done[jid].communities, ref.communities)
+    # At least most jobs survive this plan (every=3 fires on batch
+    # passages; isolation saves the members).
+    assert len(done) >= 4
+
+
+def test_poison_mid_drain_terminates(real_graphs):
+    """ISSUE 11 satellite: a poison job sitting in the queue when
+    drain() is called must not wedge the drain — the drain terminates,
+    batchmates complete, done+failed == submitted."""
+    poison = Graph.from_edges(4, np.array([0]), np.array([1]),
+                              weights=np.array([0.0]))  # 2m == 0
+    from cuvite_tpu.obs import FlightRecorder, MemoryTraceSink, spans_of
+    from cuvite_tpu.utils.trace import Tracer
+
+    sink = MemoryTraceSink()
+    rec = FlightRecorder(sink, watch_compiles=False)
+    srv = LouvainServer(ServeConfig(b_max=4, linger_s=60.0),
+                        clock=FakeClock(), tracer=Tracer(recorder=rec))
+    with rec:
+        good = [srv.submit(g) for g in real_graphs[:2]]
+        bad = srv.submit(poison)
+        done = dict(srv.drain())   # linger never fires: pure drain path
+    assert set(done) == set(good)
+    assert [j for j, _ in srv.failures] == [bad]
+    assert srv.pending() == 0
+    # The satellite's conservation form:
+    assert srv.stats.jobs_done + srv.stats.jobs_failed \
+        == srv.stats.jobs_submitted
+    drains = spans_of(sink.records, "drain")
+    assert len(drains) == 1 and drains[0]["end"] is not None

@@ -19,9 +19,10 @@ valuable result first):
       also bucketed vs pallas SPMD over all devices;
   E.  bench at scale 22;
   then tools/heavy_ab.py (heavy-class kernel decision measurement),
-  stage F (seg-coalesce fullrun A/B, ISSUE 8) and stage G (batched
+  stage F (seg-coalesce fullrun A/B, ISSUE 8), stage G (batched
   multi-tenant serving at B in {1, 8, 64} — jobs/sec + pack_util,
-  ISSUE 9).
+  ISSUE 9) and stage H (load generator vs the async daemon at
+  B in {8, 64} — on-chip SLO row + SIGTERM drain check, ISSUE 11).
 
 Success marker: tools/TPU_LADDER3_DONE (platform!=cpu bench JSON
 landed).  Every result appends to tools/logs/tpu_ladder_r4.log immediately.
@@ -282,6 +283,37 @@ def stage_g():
                     "recompiled; no JSON by design")
 
 
+def stage_h():
+    """Staged on-chip saturation run (ISSUE 11): the open-loop load
+    generator drives the async daemon over its socket at B in {8, 64},
+    SIGTERMs it, and verifies the graceful drain — so the first
+    platform=tpu serving record includes an SLO row (goodput at an
+    offered rate, wait_p95 vs the 500 ms SLO, reject/shed counts,
+    daemon exit code).  Each B writes its own JSON the moment it
+    exists; rates start conservative (the CPU saturation numbers in
+    BASELINE.md round-13) — the point is the SLO row and the clean
+    drain on chip, not a chip-side sweep."""
+    for b, rate in ((8, 20.0), (64, 60.0)):
+        out_path = os.path.join(REPO, f"tools/serve_tpu_daemon_b{b}.json")
+        t0 = time.perf_counter()
+        try:
+            out = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "serve_load.py"), "daemon",
+                 "--b-max", str(b), "--rate", str(rate),
+                 "--jobs", "128", "--edges", "4096",
+                 "--slo-ms", "500", "--tenants", "4",
+                 "--out", out_path],
+                capture_output=True, text=True, timeout=1800, cwd=REPO)
+        except subprocess.TimeoutExpired:
+            log(f"H: daemon B={b} TIMEOUT (1800s)")
+            continue
+        last = out.stdout.strip().splitlines()
+        log(f"H: daemon B={b} rate={rate} rc={out.returncode} "
+            f"wall={time.perf_counter()-t0:.0f}s "
+            f"json={last[-1] if last else out.stderr[-200:]}")
+
+
 def main():
     parts = probe()
     if parts is None:
@@ -348,6 +380,12 @@ def main():
         stage_g()
     except Exception as e:
         log(f"G: FAILED {type(e).__name__}: {e}")
+    # Stage H (ISSUE 11): load generator vs the async daemon on chip —
+    # the first platform=tpu serving SLO row + SIGTERM drain check.
+    try:
+        stage_h()
+    except Exception as e:
+        log(f"H: FAILED {type(e).__name__}: {e}")
     if got_tpu_json:
         with open(DONE, "w") as f:
             f.write(time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()) + "\n")
