@@ -10,10 +10,15 @@ diverge from sequential quality (arXiv:1702.04645, arXiv:1805.10904).
 graftlint encodes them as lint rules so every future PR is checked at
 AST-walk cost instead of multi-host reproduction cost.
 
-Layout:
-  engine.py   — source loading, rule registry, suppressions, baseline
-  rules.py    — the shipped rule set (R001..R012)
-  __main__.py — CLI: python -m cuvite_tpu.analysis [paths] [options]
+Layout (the three tiers, ANALYSIS.md "Tiers"):
+  engine.py      — source loading, rule registry, suppressions, baseline
+  rules.py       — tier 1: the per-file lexical rule set (R001..R016)
+  callgraph.py   — tier 2: cross-module jit-reachability (R017/R018)
+  lockset.py     — tier 2b: serve/ lockset concurrency checker (R019)
+  cache.py       — incremental lint cache (content-hash keyed)
+  jaxpr_audit.py — tier 3: jaxpr lint + compile-budget audit (J*/B*
+                   findings; driven by tools/compile_audit.py)
+  __main__.py    — CLI: python -m cuvite_tpu.analysis [paths] [options]
 
 See ANALYSIS.md at the repo root for the rule catalogue, suppression
 syntax (``# graftlint: disable=R001``) and the baseline workflow.
@@ -31,8 +36,15 @@ from cuvite_tpu.analysis.engine import (
     write_baseline,
 )
 
-# Importing the rules module populates the registry as a side effect.
-from cuvite_tpu.analysis import rules as _rules  # noqa: F401
+# Importing the rule modules populates the registry as a side effect
+# (tier 1 lexical rules, tier 2 cross-module rules, tier 2b lockset).
+from cuvite_tpu.analysis import rules as _rules        # noqa: F401
+from cuvite_tpu.analysis import callgraph as _cg       # noqa: F401
+from cuvite_tpu.analysis import lockset as _lockset    # noqa: F401
+from cuvite_tpu.analysis.callgraph import (
+    run_project,
+    run_project_sources,
+)
 
 __all__ = [
     "Finding",
@@ -42,6 +54,8 @@ __all__ = [
     "apply_baseline",
     "load_baseline",
     "run_paths",
+    "run_project",
+    "run_project_sources",
     "run_source",
     "write_baseline",
 ]

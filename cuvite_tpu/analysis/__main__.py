@@ -1,9 +1,9 @@
 """graftlint CLI.
 
 Usage:
-    python -m cuvite_tpu.analysis [paths...] [--format text|json]
-        [--baseline FILE] [--write-baseline] [--fail-on high|medium|low]
-        [--list-rules]
+    python -m cuvite_tpu.analysis [paths...] [--format text|json|sarif]
+        [--baseline FILE] [--write-baseline] [--prune-baseline]
+        [--fail-on high|medium|low] [--cache FILE] [--list-rules]
 
 Exit status: 0 when no NON-BASELINED finding at or above the gate
 severity (default: high) remains; 1 otherwise; 2 on usage errors.
@@ -11,12 +11,21 @@ The repo's canonical invocation (what tests/test_analysis.py and
 tools/lint.sh run) is:
 
     python -m cuvite_tpu.analysis cuvite_tpu tools tests \
-        --baseline tools/graftlint_baseline.json
+        --baseline tools/graftlint_baseline.json \
+        --cache tools/.graftlint_cache.json
+
+``--format sarif`` emits SARIF 2.1.0 for CI annotation (one result per
+non-baselined finding, rule metadata included, snippet-hash partial
+fingerprints).  ``--prune-baseline`` rewrites the baseline dropping
+entries whose fingerprint matches no current finding (each dead entry
+silently admits one future regression); a staleness count is reported
+on every text run regardless.
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import sys
 
@@ -25,13 +34,71 @@ from cuvite_tpu.analysis.engine import (
     all_rules,
     apply_baseline,
     gate_failures,
+    linted_rels,
     load_baseline,
+    prune_baseline,
     run_paths,
+    stale_baseline_entries,
     write_baseline,
 )
-from cuvite_tpu.analysis import rules as _rules  # noqa: F401 (registry)
+from cuvite_tpu.analysis import rules as _rules        # noqa: F401
+from cuvite_tpu.analysis import callgraph as _cg       # noqa: F401
+from cuvite_tpu.analysis import lockset as _lockset    # noqa: F401
 
 DEFAULT_PATHS = ["cuvite_tpu", "tools", "tests"]
+
+_SARIF_LEVEL = {"high": "error", "medium": "warning", "low": "note"}
+
+
+def to_sarif(findings, baselined: int = 0) -> dict:
+    """SARIF 2.1.0 document for a finding list.  Fingerprints hash the
+    same (path, rule, snippet) triple the baseline keys on, so CI-side
+    dedup tracks findings across line drift exactly like the gate."""
+    rules_meta = [{
+        "id": r.id,
+        "name": type(r).__name__,
+        "shortDescription": {"text": r.title},
+        "defaultConfiguration": {"level": _SARIF_LEVEL[r.severity]},
+    } for r in all_rules()]
+    rules_meta.append({
+        "id": "E000",
+        "name": "UnprocessableInput",
+        "shortDescription": {"text": "unreadable or unparsable input"},
+        "defaultConfiguration": {"level": "error"},
+    })
+    results = []
+    for f in findings:
+        fp = hashlib.sha256(
+            "\x1f".join((f.path, f.rule, f.snippet)).encode()).hexdigest()
+        results.append({
+            "ruleId": f.rule,
+            "level": _SARIF_LEVEL.get(f.severity, "warning"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {
+                        "startLine": max(f.line, 1),
+                        "snippet": {"text": f.snippet},
+                    },
+                },
+            }],
+            "partialFingerprints": {"graftlintFingerprint/v1": fp},
+        })
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "https://example.invalid/cuvite_tpu/ANALYSIS.md",
+                "rules": rules_meta,
+            }},
+            "results": results,
+            "properties": {"baselinedFindings": baselined},
+        }],
+    }
 
 
 def main(argv=None) -> int:
@@ -41,15 +108,27 @@ def main(argv=None) -> int:
     ap.add_argument("paths", nargs="*", default=None,
                     help=f"files/directories to lint (default: "
                          f"{' '.join(DEFAULT_PATHS)})")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
     ap.add_argument("--baseline", metavar="FILE", default=None,
                     help="JSON baseline of grandfathered findings")
     ap.add_argument("--write-baseline", action="store_true",
                     help="write ALL current findings to --baseline and "
                          "exit 0 (requires --baseline)")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop baseline entries whose fingerprint "
+                         "matches no current finding (requires "
+                         "--baseline)")
     ap.add_argument("--fail-on", choices=SEVERITIES, default="high",
                     help="lowest severity that fails the gate "
                          "(default: high)")
+    ap.add_argument("--cache", metavar="FILE", default=None,
+                    help="incremental lint cache (per-file findings + "
+                         "tier-2 summaries keyed on content sha256 + "
+                         "rules version); bit-identical to a cold run")
+    ap.add_argument("--no-project", action="store_true",
+                    help="skip the tier-2 cross-module pass "
+                         "(R017/R018) — per-file rules only")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -59,7 +138,8 @@ def main(argv=None) -> int:
         return 0
 
     paths = args.paths or DEFAULT_PATHS
-    findings = run_paths(paths)
+    findings = run_paths(paths, project=not args.no_project,
+                         cache=args.cache)
 
     if args.write_baseline:
         if not args.baseline:
@@ -79,17 +159,39 @@ def main(argv=None) -> int:
             return 1
         return 0
 
+    # Baseline hygiene is SCOPED to the files this run actually linted:
+    # a subset run (lint.sh --changed, explicit path args) must neither
+    # report nor prune another file's live grandfathered entries.
+    linted = linted_rels(paths)
+
+    if args.prune_baseline:
+        if not args.baseline:
+            ap.error("--prune-baseline requires --baseline FILE")
+        if args.no_project:
+            # R017/R018 entries would look dead with the tier switched
+            # off and be silently deleted.
+            ap.error("--prune-baseline cannot run with --no-project")
+        dropped = prune_baseline(args.baseline, findings, linted=linted)
+        print(f"pruned {dropped} stale baseline slot(s) from "
+              f"{args.baseline}")
+
     baseline = load_baseline(args.baseline) if args.baseline else {}
     new, grandfathered = apply_baseline(findings, baseline)
     failures = gate_failures(new, args.fail_on)
+    stale = stale_baseline_entries(findings, baseline, linted=linted) \
+        if baseline else []
 
     if args.format == "json":
         print(json.dumps({
             "findings": [f.to_dict() for f in new],
             "baselined": len(grandfathered),
+            "stale_baseline": len(stale),
             "gate": {"fail_on": args.fail_on,
                      "failures": len(failures)},
         }, indent=2))
+    elif args.format == "sarif":
+        print(json.dumps(to_sarif(new, baselined=len(grandfathered)),
+                         indent=2))
     else:
         for f in new:
             print(f.format())
@@ -102,6 +204,11 @@ def main(argv=None) -> int:
               f"{len(grandfathered)} baselined; "
               f"gate fail-on={args.fail_on}: "
               f"{'FAIL' if failures else 'ok'}")
+        if stale:
+            slots = sum(n for _k, n in stale)
+            print(f"graftlint: {slots} stale baseline slot(s) match no "
+                  "current finding (each silently admits one future "
+                  "regression; --prune-baseline removes them)")
     return 1 if failures else 0
 
 

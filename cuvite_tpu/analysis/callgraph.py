@@ -1,0 +1,524 @@
+"""Tier 2 — cross-module jit-reachability dataflow (R017/R018).
+
+The per-file engine deliberately stops at module boundaries: its
+``jit_reachable`` closure links calls by bare name within one file, and
+ANALYSIS.md lists "a host-sync hidden behind a cross-module call from a
+jitted function" as the known false negative.  This module closes that
+hole with a *project-wide* pass:
+
+  1. every linted file is reduced to a :func:`summarize` dict — imports,
+     functions, their resolved callee names, jit/vmap/shard_map entry
+     flags, and the host-sync / device-pull call sites the cross-module
+     rules may need to anchor findings on.  Summaries are plain JSON
+     (they ride the incremental lint cache, analysis/cache.py), so the
+     whole-program pass never needs the ASTs of unchanged files;
+  2. :class:`Project` links the summaries into one call graph.  Edges
+     are followed only where they can be PROVEN: an import-resolved
+     dotted call (``driver._run_phase_loop(...)`` under ``from
+     cuvite_tpu.louvain import driver``) crosses modules, a bare name
+     links within its module (the same semantics the per-file closure
+     uses).  Unresolvable receivers (``self.x()``, call results) fall
+     back to the bare-name link — bounded, never global;
+  3. jit-reachability propagates from every entry point — ``jax.jit`` /
+     ``pjit`` roots (the engine's ``_JIT_NAMES``), plus ``shard_map`` /
+     ``vmap`` / ``pmap`` wraps and the factory idiom where the wrapped
+     callable flows through a local assignment first
+     (``body = functools.partial(_phase_body, ...); jax.jit(body)``,
+     the louvain/batched.py shape);
+  4. R017 re-runs the host-sync check (R001's call set) against the
+     TRANSITIVE closure: a helper calling ``jax.device_get`` two modules
+     away from its jitted caller is a high finding, with the reach chain
+     spelled out in the message.  R018 re-runs the device-pull check
+     (R010's call set) against reachability from the phase-transition
+     modules: a pull that R010 cannot see because the helper lives
+     outside ``louvain/``/``coarsen/`` is flagged at its true call site.
+
+Findings anchor on real (path, line, snippet) triples, so baselining and
+inline ``# graftlint: disable=R017`` suppressions work exactly as they
+do for per-file rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import collections
+
+from cuvite_tpu.analysis.engine import (
+    _JIT_NAMES,
+    Finding,
+    Rule,
+    SourceFile,
+    dotted,
+    register,
+)
+from cuvite_tpu.analysis.rules import (
+    _DEVICE_NAME_PREFIXES,
+    _DEVICE_NAME_SUFFIXES,
+    _HOST_MATERIALIZE_CALLS,
+    _HOST_PULL_CALLS,
+    HOST_SYNC_ATTRS,
+    HOST_SYNC_CALLS,
+    PHASE_TRANSITION_PREFIXES,
+)
+
+# Everything that makes the wrapped/decorated callable a traced entry
+# point: jit/pjit (the engine's set) plus the batching/SPMD transforms.
+JIT_ENTRY_CALLS = set(_JIT_NAMES) | {
+    "vmap", "jax.vmap", "pmap", "jax.pmap",
+    "shard_map", "jax.experimental.shard_map.shard_map",
+}
+
+SUMMARY_VERSION = 3
+
+
+def module_of(rel: str) -> str:
+    """Dotted module name for a repo-relative path ('tools/x.py' ->
+    'tools.x'; package __init__ collapses to the package)."""
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    mod = mod.replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+_ENTRY_LAST_PARTS = {"shard_map", "vmap", "pmap"}
+
+
+def _is_entry_call_name(name: str | None) -> bool:
+    return bool(name) and (name in JIT_ENTRY_CALLS
+                           or name.split(".")[-1] in _ENTRY_LAST_PARTS)
+
+
+def _forwarded_names(expr: ast.AST) -> set:
+    """Names ``expr`` can FORWARD as the wrapped callable: a bare name,
+    a ternary of forwardable names, the callable slot of a
+    ``functools.partial``, or the first argument of a nested entry
+    transform (``jax.jit(shard_map(body, ...))``).  Deliberately NOT
+    'every Name in the expression' — treating call arguments or mesh
+    objects as callables is how a reachability pass drowns in false
+    entries."""
+    out: set = set()
+    if isinstance(expr, ast.Name):
+        out.add(expr.id)
+    elif isinstance(expr, ast.IfExp):
+        out |= _forwarded_names(expr.body) | _forwarded_names(expr.orelse)
+    elif isinstance(expr, ast.Call):
+        fname = dotted(expr.func)
+        if fname in _PARTIAL_NAMES and expr.args:
+            out |= _forwarded_names(expr.args[0])
+        elif _is_entry_call_name(fname) and expr.args:
+            out |= _forwarded_names(expr.args[0])
+    return out
+
+
+def _entry_seed_names(sf: SourceFile) -> set:
+    """Local function names wrapped at a call site by a jit/vmap/
+    shard_map entry call, including flow through local assignments in
+    the same scope (the ``body = functools.partial(_phase_body, ...);
+    jax.jit(shard_map(body, ...))`` factory idiom in louvain/batched).
+    Scope-aware: an assignment in one function never feeds a wrap in
+    another."""
+    assign_map: dict = {}  # (scope id, name) -> forwardable names
+    for node in sf.walk():
+        if not isinstance(node, ast.Assign):
+            continue
+        fwd = _forwarded_names(node.value)
+        if not fwd:
+            continue
+        scope = sf.enclosing_function(node)
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                assign_map.setdefault((id(scope), t.id), set()).update(fwd)
+    seeds: set = set()
+    for node in sf.walk():
+        if not isinstance(node, ast.Call) \
+                or not _is_entry_call_name(dotted(node.func)) \
+                or not node.args:
+            continue
+        scope = sf.enclosing_function(node)
+        work = _forwarded_names(node.args[0])
+        for _ in range(4):  # bounded assignment-chain expansion
+            nxt = set()
+            for n in work:
+                nxt |= assign_map.get((id(scope), n), set())
+                nxt |= assign_map.get((id(None), n), set())
+            if nxt <= work:
+                break
+            work |= nxt
+        seeds |= work
+    return seeds
+
+
+# The tier-2 host-sync call set: R001's minus the bare float()/int()/
+# bool() conversions.  In-module, the engine KNOWS a function is traced,
+# so concretizing casts are real findings; across modules most reached
+# helpers also run at trace time on static values (shape math, accum
+# tags), where int(nv_pad) is idiomatic — keeping the casts would bury
+# the unambiguous pulls under hundreds of false positives.  The
+# unambiguous set: explicit device pulls and array materializations.
+TRANSITIVE_SYNC_CALLS = HOST_SYNC_CALLS - {"float", "int", "bool"}
+TRANSITIVE_SYNC_ATTRS = HOST_SYNC_ATTRS
+
+
+def _classify_call(sf: SourceFile, node: ast.Call):
+    """(sync_label, pull_label) for one call node — the R001 host-sync
+    and R010 device-pull classifications, shared (minus the trace-time
+    casts, see TRANSITIVE_SYNC_CALLS) so tier 2 cannot drift from the
+    per-file rules."""
+    name = dotted(node.func)
+    sync = None
+    if name in TRANSITIVE_SYNC_CALLS:
+        sync = f"{name}()"
+    elif isinstance(node.func, ast.Attribute) \
+            and node.func.attr in TRANSITIVE_SYNC_ATTRS and not node.args:
+        sync = f".{node.func.attr}()"
+    pull = None
+    if name in _HOST_PULL_CALLS:
+        pull = f"{name}()"
+    elif name in _HOST_MATERIALIZE_CALLS and node.args:
+        arg = node.args[0]
+        if isinstance(arg, ast.Name) and (
+                arg.id.endswith(_DEVICE_NAME_SUFFIXES)
+                or arg.id.startswith(_DEVICE_NAME_PREFIXES)):
+            pull = f"{name}({arg.id})"
+    return sync, pull
+
+
+def summarize(sf: SourceFile) -> dict:
+    """The JSON-serializable cross-module facts of one file (see module
+    docstring).  Everything tier 2 reads comes from here — the ASTs of
+    cache-hit files are never rebuilt."""
+    imports: dict = {}       # local alias -> full module name
+    from_imports: dict = {}  # local name -> [module, symbol]
+    mod = module_of(sf.rel)
+    pkg_parts = mod.split(".")
+    if not sf.rel.endswith("__init__.py"):
+        pkg_parts = pkg_parts[:-1]
+    for node in sf.walk():
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    imports[a.asname] = a.name
+                else:
+                    # `import a.b.c` binds the TOP package; the dotted
+                    # call path supplies the rest (a.b.c.f resolves by
+                    # appending the middle parts to the head binding).
+                    head = a.name.split(".")[0]
+                    imports[head] = head
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                src = ".".join(base + ([node.module] if node.module else []))
+            else:
+                src = node.module or ""
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                from_imports[a.asname or a.name] = [src, a.name]
+
+    seeds = _entry_seed_names(sf)
+    # Wrapped names that are NOT local functions (``jax.jit(step)``
+    # where step was imported): recorded raw, resolved to their home
+    # module at project-link time.
+    entry_wraps = sorted(s for s in seeds if s not in sf.func_by_name)
+    entry_decorators = JIT_ENTRY_CALLS
+    funcs = []
+    # Group call facts by enclosing FunctionInfo in ONE walk (the
+    # per-function re-walk is quadratic on big files).
+    per_func: dict = collections.defaultdict(
+        lambda: {"calls": set(), "sync": [], "pull": []})
+    for node in sf.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        info = sf.enclosing_function(node)
+        if info is None:
+            continue
+        facts = per_func[id(info)]
+        name = dotted(node.func)
+        if name:
+            facts["calls"].add(name)
+        elif isinstance(node.func, ast.Attribute):
+            facts["calls"].add(node.func.attr)
+        sync, pull = _classify_call(sf, node)
+        line = getattr(node, "lineno", 1)
+        if sync:
+            facts["sync"].append(
+                {"label": sync, "line": line, "snippet": sf.line(line)})
+        if pull:
+            facts["pull"].append(
+                {"label": pull, "line": line, "snippet": sf.line(line)})
+    for info in sf.functions:
+        is_entry = info.is_jit or info.name in seeds or any(
+            (dotted(d) in entry_decorators)
+            or (isinstance(d, ast.Call) and dotted(d.func) in entry_decorators)
+            for d in info.node.decorator_list)
+        facts = per_func.get(id(info), {"calls": set(), "sync": [],
+                                        "pull": []})
+        funcs.append({
+            "name": info.name,
+            "line": getattr(info.node, "lineno", 1),
+            "entry": bool(is_entry),
+            "local_jit_reachable": bool(info.jit_reachable),
+            "calls": sorted(facts["calls"]),
+            "sync_sites": facts["sync"],
+            "pull_sites": facts["pull"],
+        })
+    return {
+        "version": SUMMARY_VERSION,
+        "rel": sf.rel,
+        "module": mod,
+        "imports": imports,
+        "from_imports": from_imports,
+        "entry_wraps": entry_wraps,
+        "functions": funcs,
+        "suppress": {str(ln): sorted(ids)
+                     for ln, ids in sf._line_suppress.items()},
+        "file_suppress": sorted(sf._file_suppress),
+    }
+
+
+class Project:
+    """The linked whole-program view over a set of file summaries."""
+
+    def __init__(self, summaries):
+        self.summaries = [s for s in summaries
+                          if s and s.get("version") == SUMMARY_VERSION]
+        self.by_module: dict = {}
+        for s in self.summaries:
+            self.by_module[s["module"]] = s
+        # (module, func name) -> list of function dicts (same-named defs
+        # collapse, matching the per-file closure's name semantics).
+        self.funcs: dict = collections.defaultdict(list)
+        for s in self.summaries:
+            for fn in s["functions"]:
+                self.funcs[(s["module"], fn["name"])].append(fn)
+        self._edges_cache: dict = {}
+
+    # -- linking -------------------------------------------------------
+
+    def _resolve(self, summary: dict, callee: str):
+        """One raw callee name -> (module, funcname) or None.  Dotted
+        names resolve through the module's imports (longest module
+        prefix wins); anything unresolved degrades to a bare-name link
+        within the module — exactly the per-file closure's reach."""
+        parts = callee.split(".")
+        if len(parts) > 1:
+            head, last = parts[0], parts[-1]
+            tgt = None
+            if head in summary["imports"]:
+                base = summary["imports"][head]
+                mid = parts[1:-1]
+                tgt = ".".join([base] + mid)
+            elif head in summary["from_imports"]:
+                m, sym = summary["from_imports"][head]
+                tgt = ".".join([m, sym] + parts[1:-1])
+            if tgt is not None and tgt in self.by_module \
+                    and (tgt, last) in self.funcs:
+                return (tgt, last)
+            return (summary["module"], last)
+        if callee in summary["from_imports"]:
+            m, sym = summary["from_imports"][callee]
+            # `from pkg import mod` binds a submodule, not a symbol.
+            if ".".join([m, sym]) in self.by_module:
+                return None
+            if (m, sym) in self.funcs:
+                return (m, sym)
+            # Symbol re-exported through a package __init__: best-effort
+            # one-hop follow of ITS from-imports.
+            pkg = self.by_module.get(m)
+            if pkg and sym in pkg["from_imports"]:
+                m2, sym2 = pkg["from_imports"][sym]
+                if (m2, sym2) in self.funcs:
+                    return (m2, sym2)
+            return None
+        return (summary["module"], callee)
+
+    def _edges_of(self, module: str, fn: dict) -> list:
+        key = (module, fn["name"], fn["line"])
+        hit = self._edges_cache.get(key)
+        if hit is not None:
+            return hit
+        summary = self.by_module[module]
+        out = []
+        for callee in fn["calls"]:
+            tgt = self._resolve(summary, callee)
+            if tgt is not None and tgt in self.funcs:
+                out.append(tgt)
+        self._edges_cache[key] = out
+        return out
+
+    def _reach(self, seed_keys) -> dict:
+        """BFS over the call graph; returns {(module, name): pred-key}
+        (seeds map to None) for chain reconstruction."""
+        pred: dict = {}
+        queue = collections.deque()
+        for k in seed_keys:
+            if k in self.funcs and k not in pred:
+                pred[k] = None
+                queue.append(k)
+        while queue:
+            cur = queue.popleft()
+            for fn in self.funcs[cur]:
+                for tgt in self._edges_of(cur[0], fn):
+                    if tgt not in pred:
+                        pred[tgt] = cur
+                        queue.append(tgt)
+        return pred
+
+    def chain(self, pred: dict, key) -> str:
+        parts = []
+        seen = set()
+        while key is not None and key not in seen:
+            seen.add(key)
+            mod, name = key
+            rel = self.by_module[mod]["rel"]
+            parts.append(f"{rel}::{name}")
+            key = pred.get(key)
+        return " <- ".join(parts)
+
+    # -- rule-facing helpers -------------------------------------------
+
+    def jit_reach(self) -> dict:
+        seeds = [(s["module"], fn["name"]) for s in self.summaries
+                 for fn in s["functions"] if fn["entry"]]
+        # Imported callables wrapped at a call site (jax.jit(step) where
+        # step came from another module) seed their HOME definition.
+        for s in self.summaries:
+            for name in s.get("entry_wraps", ()):
+                tgt = self._resolve(s, name)
+                if tgt is not None and tgt in self.funcs:
+                    seeds.append(tgt)
+        return self._reach(seeds)
+
+    def phase_transition_reach(self) -> dict:
+        seeds = [(s["module"], fn["name"]) for s in self.summaries
+                 if s["rel"].startswith(PHASE_TRANSITION_PREFIXES)
+                 for fn in s["functions"]]
+        return self._reach(seeds)
+
+    def suppressed(self, summary: dict, line: int, rule_id: str) -> bool:
+        fs = set(summary.get("file_suppress", ()))
+        if rule_id in fs or "all" in fs:
+            return True
+        ids = set(summary.get("suppress", {}).get(str(line), ()))
+        return rule_id in ids or "all" in ids
+
+
+class ProjectRule(Rule):
+    """A rule that needs the whole-program view.  ``check`` (per-file)
+    is a no-op; the engine's project pass calls ``check_project``."""
+
+    def check(self, sf):
+        return ()
+
+    def check_project(self, project: Project):
+        raise NotImplementedError
+
+    def project_finding(self, summary: dict, site: dict,
+                        message: str) -> Finding:
+        return Finding(rule=self.id, severity=self.severity,
+                       path=summary["rel"], line=site["line"],
+                       message=message, snippet=site["snippet"])
+
+
+@register
+class TransitiveHostSync(ProjectRule):
+    id = "R017"
+    severity = "high"
+    title = "host-sync call transitively reachable from a jit/vmap/" \
+            "shard_map entry point (cross-module)"
+
+    def check_project(self, project: Project):
+        pred = project.jit_reach()
+        for summary in project.summaries:
+            mod = summary["module"]
+            for fn in summary["functions"]:
+                key = (mod, fn["name"])
+                if key not in pred:
+                    continue
+                if fn["local_jit_reachable"]:
+                    continue  # R001's per-file closure already covers it
+                chain = project.chain(pred, key)
+                for site in fn["sync_sites"]:
+                    yield self.project_finding(
+                        summary, site,
+                        f"{site['label']} in '{fn['name']}' is "
+                        f"transitively reachable from a traced entry "
+                        f"point ({chain}): a blocking device->host sync "
+                        "(or trace-time concretization) the per-file "
+                        "R001 closure cannot see across the module "
+                        "boundary")
+
+
+@register
+class TransitiveDevicePull(ProjectRule):
+    id = "R018"
+    severity = "high"
+    title = "device->host pull in a helper reached from phase-" \
+            "transition code (cross-module)"
+
+    def check_project(self, project: Project):
+        pred = project.phase_transition_reach()
+        for summary in project.summaries:
+            if summary["rel"].startswith(PHASE_TRANSITION_PREFIXES):
+                continue  # R010 owns the in-scope modules
+            mod = summary["module"]
+            for fn in summary["functions"]:
+                key = (mod, fn["name"])
+                if key not in pred:
+                    continue
+                chain = project.chain(pred, key)
+                for site in fn["pull_sites"]:
+                    yield self.project_finding(
+                        summary, site,
+                        f"{site['label']} in '{fn['name']}' is reached "
+                        f"from phase-transition code ({chain}): the "
+                        "O(E)/O(V) host materialization R010 polices "
+                        "has moved into a helper module where the "
+                        "per-file rule cannot see it; keep the slab in "
+                        "HBM or justify with an inline disable")
+
+
+def run_project(summaries, rules=None) -> list:
+    """All project-tier findings over a summary set, suppression-
+    filtered.  ``rules`` (when given) selects which ProjectRules run —
+    the same contract as run_source's ``rules``."""
+    from cuvite_tpu.analysis.engine import all_rules
+
+    project = Project(summaries)
+    selected = [r for r in (all_rules() if rules is None else rules)
+                if isinstance(r, ProjectRule)]
+    out = []
+    seen = set()
+    for rule in selected:
+        for f in rule.check_project(project):
+            summary = project.by_module.get(module_of(f.path))
+            if summary is not None \
+                    and project.suppressed(summary, f.line, f.rule):
+                continue
+            # Same-named defs collapse in the call graph, so one site
+            # can surface once per homonym — dedupe on the anchor.
+            key = (f.path, f.line, f.rule)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def run_project_sources(sources: dict, rules=None) -> list:
+    """Test-facing: lint a {rel: source text} dict as one project —
+    per-file findings plus the cross-module tier, exactly what
+    run_paths produces for the same tree on disk."""
+    from cuvite_tpu.analysis.engine import run_source
+
+    findings = []
+    summaries = []
+    for rel, text in sorted(sources.items()):
+        findings.extend(run_source(text, path=rel, rules=rules, rel=rel))
+        summaries.append(summarize(SourceFile(text, path=rel, rel=rel)))
+    findings.extend(run_project(summaries, rules=rules))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

@@ -393,15 +393,19 @@ def _severity_rank(sev: str) -> int:
 
 
 def run_source(text: str, path: str = "<string>", rules=None,
-               rel: str | None = None) -> list:
+               rel: str | None = None, *,
+               sf: "SourceFile | None" = None) -> list:
     """Lint one source string; returns suppression-filtered findings.
 
     The unit-test entry point: rules see exactly what they would see for
-    a real file at ``rel``/``path``.
-    """
+    a real file at ``rel``/``path``.  ``sf`` lets run_paths pass the
+    SourceFile it already built (it needs one for the tier-2 summary) —
+    the check/suppress/sort semantics then live HERE, once, for both
+    entry points."""
     if rules is None:
         rules = all_rules()
-    sf = SourceFile(text, path=path, rel=rel)
+    if sf is None:
+        sf = SourceFile(text, path=path, rel=rel)
     out = []
     for rule in rules:
         for f in rule.check(sf):
@@ -460,21 +464,16 @@ def _relpath(path: str, anchor: str | None = None) -> str:
     return ap.replace(os.sep, "/")
 
 
-def run_paths(paths: Iterable[str], rules=None) -> list:
-    """Lint every .py file under ``paths``.  Failure is CLOSED on both
-    bad inputs: an unparsable file yields a high-severity E000 finding
-    instead of aborting the run, and an input path with no Python files
-    under it (typo, renamed directory) yields one too — otherwise a
-    stale CI invocation would print 'ok' forever while linting
-    nothing."""
-    if rules is None:
-        rules = all_rules()
-    findings = []
-    files = []
+def _collect_files(paths: Iterable[str]):
+    """([(abs file, anchor)], [E000 findings for barren inputs]) — the
+    shared traversal of run_paths and linted_rels, so what counts as
+    'linted' cannot drift between the gate and the baseline-hygiene
+    scoping built on it."""
+    files, errors = [], []
     for p in paths:
         batch = list(iter_py_files([p]))
         if not batch:
-            findings.append(Finding(
+            errors.append(Finding(
                 rule="E000", severity="high", path=str(p), line=1,
                 message="path contains no Python files (missing or "
                         "renamed? the gate would silently pass)",
@@ -487,6 +486,43 @@ def run_paths(paths: Iterable[str], rules=None) -> list:
         if os.path.isfile(p):
             anchor = os.path.dirname(anchor)
         files.extend((f, anchor) for f in batch)
+    return files, errors
+
+
+def linted_rels(paths: Iterable[str]) -> set:
+    """The repo-relative paths a run_paths(paths) call would lint — the
+    scope guard for baseline hygiene: staleness and pruning must only
+    ever judge entries whose file was actually (re)checked."""
+    files, _errors = _collect_files(paths)
+    return {_relpath(f, anchor) for f, anchor in files}
+
+
+def run_paths(paths: Iterable[str], rules=None, *, project: bool = True,
+              cache: str | None = None) -> list:
+    """Lint every .py file under ``paths``.  Failure is CLOSED on both
+    bad inputs: an unparsable file yields a high-severity E000 finding
+    instead of aborting the run, and an input path with no Python files
+    under it (typo, renamed directory) yields one too — otherwise a
+    stale CI invocation would print 'ok' forever while linting
+    nothing.
+
+    ``project=True`` (default) additionally runs the tier-2
+    cross-module pass (analysis/callgraph.py: R017/R018) over the whole
+    file set.  ``cache`` names an incremental-cache JSON file
+    (analysis/cache.py): per-file findings and tier-2 summaries are
+    reused for files whose content hash matches, bit-identically to a
+    cold run.  The cache only engages with the full default rule set —
+    a narrowed ``rules`` list always lints cold, so cached entries can
+    never leak findings the caller did not ask for (or hide ones they
+    did)."""
+    from cuvite_tpu.analysis import callgraph
+    from cuvite_tpu.analysis.cache import LintCache, content_sha
+
+    cache_obj = LintCache(cache) if cache and rules is None else None
+    if rules is None:
+        rules = all_rules()
+    files, findings = _collect_files(paths)
+    summaries = []
     seen = set()
     for fpath, anchor in files:
         if os.path.abspath(fpath) in seen:
@@ -501,20 +537,43 @@ def run_paths(paths: Iterable[str], rules=None) -> list:
                 rule="E000", severity="high", path=rel, line=1,
                 message=f"cannot read file: {e}", snippet=""))
             continue
+        if cache_obj is not None:
+            sha = content_sha(text)
+            hit = cache_obj.get(rel, sha)
+            if hit is not None:
+                cached, summary = hit
+                findings.extend(Finding(**d) for d in cached)
+                if summary is not None:
+                    summaries.append(summary)
+                continue
         try:
-            findings.extend(run_source(text, path=fpath, rules=rules,
-                                       rel=rel))
+            sf = SourceFile(text, path=fpath, rel=rel)
         except SyntaxError as e:
             findings.append(Finding(
                 rule="E000", severity="high", path=rel,
                 line=e.lineno or 1,
                 message=f"syntax error: {e.msg}", snippet=""))
+            continue
         except ValueError as e:
             # e.g. ast.parse on a null byte: not a SyntaxError, but the
             # same fail-closed answer
             findings.append(Finding(
                 rule="E000", severity="high", path=rel, line=1,
                 message=f"unparsable source: {e}", snippet=""))
+            continue
+        per_file = run_source(text, path=fpath, rules=rules, rel=rel,
+                              sf=sf)
+        summary = None
+        if project or cache_obj is not None:
+            summary = callgraph.summarize(sf)
+            summaries.append(summary)
+        findings.extend(per_file)
+        if cache_obj is not None:
+            cache_obj.put(rel, sha, per_file, summary)
+    if project:
+        findings.extend(callgraph.run_project(summaries, rules=rules))
+    if cache_obj is not None:
+        cache_obj.save()
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 
@@ -576,6 +635,64 @@ def apply_baseline(findings: list, baseline: collections.Counter):
         else:
             new.append(f)
     return new, old
+
+
+def stale_baseline_entries(findings: list, baseline: collections.Counter,
+                           linted: set | None = None) -> list:
+    """Baseline slots no CURRENT finding consumes: [(fingerprint,
+    n_unmatched)].  Each dead slot silently admits one future regression
+    at the same (path, rule, snippet) — the hygiene report surfaces
+    them and ``--prune-baseline`` deletes them.
+
+    ``linted`` (a set of repo-relative paths, see :func:`linted_rels`)
+    scopes the judgment: an entry for a file this run did NOT lint is
+    unknown, not stale — without the scope, a subset run (``lint.sh
+    --changed``, an explicit path argument) would report every other
+    file's live grandfathered findings as dead."""
+    have = collections.Counter(
+        f.fingerprint() for f in findings if f.rule != "E000")
+    out = []
+    for key, n in sorted(baseline.items()):
+        if linted is not None and key[0] not in linted:
+            continue
+        extra = n - have.get(key, 0)
+        if extra > 0:
+            out.append((key, extra))
+    return out
+
+
+def prune_baseline(path: str, findings: list,
+                   linted: set | None = None) -> int:
+    """Rewrite the baseline at ``path`` keeping, per fingerprint, only
+    as many slots as current findings consume; returns the number of
+    dead slots dropped.  A no-op (0) when the file is already tight.
+    ``linted`` scopes exactly like :func:`stale_baseline_entries`:
+    entries for files outside the linted set are KEPT untouched —
+    pruning from a subset run must never delete another file's live
+    grandfathered slots."""
+    baseline = load_baseline(path)
+    have = collections.Counter(
+        f.fingerprint() for f in findings if f.rule != "E000")
+    kept: collections.Counter = collections.Counter()
+    dropped = 0
+    for key, n in baseline.items():
+        if linted is not None and key[0] not in linted:
+            kept[key] = n
+            continue
+        keep = min(n, have.get(key, 0))
+        if keep:
+            kept[key] = keep
+        dropped += n - keep
+    if dropped:
+        ents = [
+            {"path": p, "rule": r, "snippet": s, "count": c}
+            for (p, r, s), c in sorted(kept.items())
+        ]
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump({"version": BASELINE_VERSION, "findings": ents}, fh,
+                      indent=2, sort_keys=True)
+            fh.write("\n")
+    return dropped
 
 
 def gate_failures(findings: list, min_severity: str = "high") -> list:

@@ -201,7 +201,7 @@ def gather_global(arr) -> np.ndarray:
     numpy array on EVERY process — the `MPI_Allgatherv` of the output path
     (cf. gatherAllComm, /root/reference/louvain.cpp:3306-3347)."""
     if not is_distributed():
-        return np.asarray(jax.device_get(arr))
+        return np.asarray(jax.device_get(arr))  # graftlint: disable=R018 — gather_global IS the sanctioned host gather; phase-transition callers opt in per site (R010 disables at _phase_sync / the final label gather)
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(arr, tiled=True))

@@ -347,8 +347,16 @@ class ServeDaemon:
             self._forget(client)
 
     def _route_results(self, finished, fails, sheds) -> None:
+        # The route-table pops hold the daemon lock like the inserts in
+        # _handle_submit do (graftlint R019: _routes' lock discipline is
+        # established there) — an unlocked pop could interleave with a
+        # reader thread's duplicate-id check and route a result to the
+        # wrong client.  Taken per pop, NOT around the sends: a slow
+        # client must never stall intake on a held lock.
         for job_id, res in finished:
-            client, want_labels = self._routes.pop(job_id, (None, False))
+            with self.lock:
+                client, want_labels = self._routes.pop(job_id,
+                                                       (None, False))
             payload = {"job_id": job_id,
                        "q": round(float(res.modularity), 6),
                        "communities": int(res.num_communities),
@@ -358,11 +366,13 @@ class ServeDaemon:
                 payload["labels"] = [int(x) for x in res.communities]
             self._send_or_drop(client, {"result": payload})
         for job_id, err in fails:
-            client, _ = self._routes.pop(job_id, (None, False))
+            with self.lock:
+                client, _ = self._routes.pop(job_id, (None, False))
             self._send_or_drop(client,
                                {"failed": {"job_id": job_id, "error": err}})
         for job_id, late_s in sheds:
-            client, _ = self._routes.pop(job_id, (None, False))
+            with self.lock:
+                client, _ = self._routes.pop(job_id, (None, False))
             self._send_or_drop(client,
                                {"shed": {"job_id": job_id,
                                          "late_s": round(late_s, 6)}})

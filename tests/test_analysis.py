@@ -497,6 +497,55 @@ def injected_default(clock=time.monotonic):
 """,
         "cuvite_tpu/serve/fake_r016.py",
     ),
+    (
+        "R019",
+        """
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.jobs_done = 0
+        self.samples = []
+
+    def record(self, wait):
+        with self.lock:
+            self.jobs_done += 1
+            self.samples.append(wait)
+
+    def racy(self, wait):
+        # the PR-11 shape: same fields, no lock — lost updates under the
+        # daemon's reader/dispatcher concurrency
+        self.jobs_done += 1
+        self.samples.append(wait)
+""",
+        """
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.jobs_done = 0
+        self.samples = []
+        self.jobs_done = 0       # ctor re-init: construction, not a race
+
+    def record(self, wait):
+        with self.lock:
+            self.jobs_done += 1
+            self.samples.append(wait)
+
+
+class SingleThreaded:
+    def __init__(self):
+        self.count = 0
+
+    def bump(self):
+        self.count += 1          # no lock discipline anywhere: unflagged
+""",
+        "cuvite_tpu/serve/fake_r019.py",
+    ),
 ]
 
 RULE_IDS = [c[0] for c in RULE_CASES]
@@ -520,7 +569,7 @@ def test_rule_negative(rule_id, bad, good, rel):
 def test_registry_ships_at_least_eight_rules():
     rules = all_rules()
     assert len(rules) >= 8
-    assert {r.id for r in rules} >= set(RULE_IDS)
+    assert {r.id for r in rules} >= set(RULE_IDS) | {"R017", "R018"}
     for r in rules:
         assert r.severity in ("high", "medium", "low")
         assert r.title
@@ -909,16 +958,33 @@ def test_run_paths_walks_directories(tmp_path):
 
 def test_selflint_no_new_high_findings(monkeypatch):
     """THE tier-1 gate: zero non-baselined high-severity findings across
-    the repo's source, tools, and tests."""
+    the repo's source, tools, and tests — ALL tiers (per-file rules,
+    the cross-module R017/R018 pass, the serve/ lockset R019).  Runs
+    through the incremental cache (the same one tools/lint.sh warms; a
+    hit is pinned bit-identical to cold by
+    test_cache_hit_bit_identical)."""
+    import warnings as _warnings
+
+    from cuvite_tpu.analysis.engine import stale_baseline_entries
+
     monkeypatch.chdir(REPO)
-    findings = run_paths(SCAN_PATHS)
-    new, _ = apply_baseline(findings, load_baseline(BASELINE))
+    findings = run_paths(SCAN_PATHS, cache=os.path.join(
+        REPO, "tools", ".graftlint_cache.json"))
+    baseline = load_baseline(BASELINE)
+    new, _ = apply_baseline(findings, baseline)
     failures = gate_failures(new, "high")
     assert not failures, \
         "new high-severity graftlint findings (fix, suppress with a " \
         "justified '# graftlint: disable=R###', or re-baseline " \
         "deliberately via tools/lint.sh --write-baseline):\n" + \
         "\n".join(f.format() for f in failures)
+    # Baseline hygiene rides along as a WARNING, not a failure: a dead
+    # entry silently admits one future regression at its fingerprint.
+    stale = stale_baseline_entries(findings, baseline)
+    if stale:
+        _warnings.warn(
+            "graftlint baseline has stale entries (run tools/lint.sh "
+            f"--prune-baseline): {stale}")
 
 
 def test_gate_is_cwd_independent(tmp_path, monkeypatch):
@@ -992,3 +1058,661 @@ def test_cli_subprocess_entrypoint():
          "--baseline", BASELINE],
         capture_output=True, text=True, cwd=REPO, timeout=120)
     assert out.returncode == 0, out.stdout + out.stderr
+
+
+# ---------------------------------------------------------------------------
+# Tier 2: cross-module jit-reachability (R017/R018).  Fixtures are
+# multi-file {rel: source} projects linted through run_project_sources —
+# the same path run_paths takes for a tree on disk.
+
+from cuvite_tpu.analysis import run_project_sources  # noqa: E402
+
+R017_DEEP = {
+    # jit root -> mid helper (module 2) -> device_get (module 3): the
+    # exact false negative ANALYSIS.md used to document as out of scope.
+    "cuvite_tpu/louvain/fake_root.py": """
+import jax
+
+from cuvite_tpu.fake_mid import mid_helper
+
+@jax.jit
+def step(x):
+    return mid_helper(x)
+""",
+    "cuvite_tpu/fake_mid.py": """
+from cuvite_tpu.fake_deep import deep_pull
+
+def mid_helper(x):
+    return deep_pull(x) + 1
+""",
+    "cuvite_tpu/fake_deep.py": """
+import jax
+
+def deep_pull(x):
+    return jax.device_get(x)
+""",
+}
+
+
+def test_r017_transitive_device_get_two_modules_deep():
+    findings = run_project_sources(R017_DEEP)
+    hits = [f for f in findings if f.rule == "R017"]
+    assert len(hits) == 1, findings
+    assert hits[0].path == "cuvite_tpu/fake_deep.py"
+    assert "fake_root.py::step" in hits[0].message  # the reach chain
+    assert hits[0].severity == "high"
+
+
+def test_r017_negative_without_entry_point():
+    # Identical modules, no @jax.jit: plain host code, nothing fires.
+    clean = dict(R017_DEEP)
+    clean["cuvite_tpu/louvain/fake_root.py"] = \
+        clean["cuvite_tpu/louvain/fake_root.py"].replace("@jax.jit\n", "")
+    assert not any(f.rule == "R017"
+                   for f in run_project_sources(clean))
+
+
+def test_r017_defers_to_r001_in_module():
+    # A same-module reachable sync is R001's finding; R017 must not
+    # double-report it.
+    src = {
+        "cuvite_tpu/fake_one.py": """
+import jax
+
+@jax.jit
+def step(x):
+    return helper(x)
+
+def helper(x):
+    return jax.device_get(x)
+""",
+    }
+    rules = {f.rule for f in run_project_sources(src)}
+    assert "R001" in rules and "R017" not in rules
+
+
+def test_r017_factory_partial_shard_map_idiom():
+    """The louvain/batched.py shape: the traced body reaches jit only
+    through a functools.partial assigned to a local, wrapped in
+    shard_map — the per-file engine misses it, tier 2 must not."""
+    src = {
+        "cuvite_tpu/fake_factory.py": """
+import functools
+import jax
+
+from cuvite_tpu.fake_body import phase_body
+
+def get_phase(mesh, nv_pad):
+    body = functools.partial(phase_body, nv_pad=nv_pad)
+    return jax.jit(shard_map(body, mesh=mesh))
+
+def shard_map(f, mesh):
+    return f
+""",
+        "cuvite_tpu/fake_body.py": """
+import numpy as np
+
+def phase_body(x, *, nv_pad):
+    return np.asarray(x)
+""",
+    }
+    hits = [f for f in run_project_sources(src) if f.rule == "R017"]
+    assert len(hits) == 1 and hits[0].path == "cuvite_tpu/fake_body.py"
+
+
+def test_r017_inline_suppression():
+    src = dict(R017_DEEP)
+    src["cuvite_tpu/fake_deep.py"] = """
+import jax
+
+def deep_pull(x):
+    return jax.device_get(x)  # graftlint: disable=R017 — final gather
+"""
+    assert not any(f.rule == "R017" for f in run_project_sources(src))
+
+
+R018_PROJECT = {
+    "cuvite_tpu/coarsen/fake_phase.py": """
+from cuvite_tpu.utils.fake_pull import pull_stats
+
+def phase_transition(slab_d):
+    return pull_stats(slab_d)
+""",
+    "cuvite_tpu/utils/fake_pull.py": """
+import jax
+
+def pull_stats(slab_d):
+    return jax.device_get(slab_d)
+""",
+}
+
+
+def test_r018_pull_in_helper_reached_from_coarsen():
+    findings = run_project_sources(R018_PROJECT)
+    hits = [f for f in findings if f.rule == "R018"]
+    assert len(hits) == 1, findings
+    assert hits[0].path == "cuvite_tpu/utils/fake_pull.py"
+    assert "fake_phase.py::phase_transition" in hits[0].message
+
+
+def test_r018_negative_unreached_helper():
+    # The same helper reached only from tools/: no phase-transition
+    # caller, no finding (and R010 stays silent outside its scope).
+    src = {
+        "tools/fake_bench.py": R018_PROJECT[
+            "cuvite_tpu/coarsen/fake_phase.py"],
+        "cuvite_tpu/utils/fake_pull.py": R018_PROJECT[
+            "cuvite_tpu/utils/fake_pull.py"],
+    }
+    assert not any(f.rule in ("R018", "R010")
+                   for f in run_project_sources(src))
+
+
+def test_r018_in_scope_modules_stay_r010():
+    # A pull INSIDE louvain//coarsen/ is R010's (baselined, medium)
+    # finding; R018 covers only the helpers those modules reach.
+    src = {"cuvite_tpu/coarsen/fake_self.py": """
+import jax
+
+def phase_transition(slab_d):
+    return jax.device_get(slab_d)
+"""}
+    rules = {f.rule for f in run_project_sources(src)}
+    assert "R010" in rules and "R018" not in rules
+
+
+# ---------------------------------------------------------------------------
+# Tier 2b: lockset checker details beyond the RULE_CASES pair.
+
+
+R019_SEEDED_PR11 = """
+import threading
+
+
+class ServeStats:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.jobs_done = 0
+        self.wait_samples = []
+
+
+class Dispatcher:
+    def __init__(self, stats):
+        self.stats = stats
+
+    def locked_path(self, wait):
+        with self.stats.lock:
+            self.stats.jobs_done += 1
+            self.stats.wait_samples.append(wait)
+
+    def drain_recheck(self, wait):
+        # the PR-11 drain-recheck bug shape: the happy path takes the
+        # lock, the drain path forgot it
+        self.stats.jobs_done += 1
+        self.stats.wait_samples.append(wait)
+"""
+
+
+def test_r019_seeded_pr11_unguarded_mutation():
+    hits = [f for f in run_source(R019_SEEDED_PR11,
+                                  rel="cuvite_tpu/serve/fake_seed.py")
+            if f.rule == "R019"]
+    assert len(hits) == 2, hits          # jobs_done += and .append
+    assert all("self.stats.lock" in f.message for f in hits)
+    assert all(f.severity == "high" for f in hits)
+
+
+def test_r019_scope_is_serve_only():
+    assert not any(
+        f.rule == "R019"
+        for f in run_source(R019_SEEDED_PR11,
+                            rel="cuvite_tpu/louvain/fake_seed.py"))
+
+
+def test_r019_guarded_by_annotation():
+    """The explicit annotation establishes the discipline when NO
+    in-class mutation ever takes the lock (inference has nothing to
+    infer from)."""
+    src = """
+import threading
+
+
+class Stats:
+    lock: object = None
+    jobs_done: int = 0  # graftlint: guarded-by=self.lock
+
+    def racy(self):
+        self.jobs_done += 1
+"""
+    hits = [f for f in run_source(src, rel="cuvite_tpu/serve/fake.py")
+            if f.rule == "R019"]
+    assert len(hits) == 1 and "self.lock" in hits[0].message
+    # ...and holding the annotated lock satisfies it.
+    good = src.replace("        self.jobs_done += 1",
+                       "        with self.lock:\n"
+                       "            self.jobs_done += 1")
+    assert not any(f.rule == "R019"
+                   for f in run_source(good,
+                                       rel="cuvite_tpu/serve/fake.py"))
+
+
+def test_r019_nested_class_does_not_cross_pollute():
+    """An inner class's mutations must not inherit (or feed) the outer
+    class's inferred guards."""
+    src = """
+import threading
+
+
+class Outer:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.count = 0
+
+    def locked(self):
+        with self.lock:
+            self.count += 1
+
+    class Inner:
+        def bump(self):
+            self.count += 1   # Inner has no lock discipline of its own
+"""
+    assert not any(f.rule == "R019"
+                   for f in run_source(src,
+                                       rel="cuvite_tpu/serve/fake.py"))
+
+
+def test_r019_inline_suppression():
+    suffix = "  # graftlint: disable=R019 — single-threaded teardown"
+    lines = R019_SEEDED_PR11.splitlines()
+    # Suppress the two drain_recheck mutations (the last two statements).
+    drain_at = lines.index("    def drain_recheck(self, wait):")
+    out = [ln + suffix
+           if i > drain_at and ln.strip().startswith("self.stats.")
+           else ln
+           for i, ln in enumerate(lines)]
+    hits = [f for f in run_source("\n".join(out),
+                                  rel="cuvite_tpu/serve/fake.py")
+            if f.rule == "R019"]
+    assert hits == [], hits
+
+
+def test_r019_real_serve_package_self_lints_clean(monkeypatch):
+    """The acceptance pin: the REAL serve/ package carries no unguarded
+    mutation of an inferred/annotated guarded field."""
+    monkeypatch.chdir(REPO)
+    findings = run_paths(["cuvite_tpu/serve"], project=False)
+    assert not [f for f in findings if f.rule == "R019"], findings
+
+
+# ---------------------------------------------------------------------------
+# Incremental cache: hit == cold, bit for bit; edits invalidate.
+
+
+def _mini_tree(tmp_path):
+    tree = tmp_path / "tools"
+    tree.mkdir()
+    (tree / "a.py").write_text(SUPPRESSIBLE % "")
+    (tree / "b.py").write_text("def ok():\n    return 1\n")
+    return tree
+
+
+def test_cache_hit_bit_identical(tmp_path):
+    tree = _mini_tree(tmp_path)
+    cache = str(tmp_path / "cache.json")
+    cold = run_paths([str(tree)])                      # no cache at all
+    warm0 = run_paths([str(tree)], cache=cache)        # cold, writes
+    assert os.path.exists(cache)
+    warm1 = run_paths([str(tree)], cache=cache)        # pure hits
+    assert cold == warm0 == warm1                      # dataclass equality
+    # An edit invalidates exactly that file.
+    (tree / "b.py").write_text("import subprocess\n\n"
+                               "def bad(cmd):\n"
+                               "    return subprocess.run(cmd)\n")
+    warm2 = run_paths([str(tree)], cache=cache)
+    assert warm2 == run_paths([str(tree)])
+    assert {f.path for f in warm2 if f.rule == "R007"} \
+        == {"tools/a.py", "tools/b.py"}
+
+
+def test_cache_rules_version_invalidates(tmp_path, monkeypatch):
+    from cuvite_tpu.analysis import cache as cache_mod
+
+    tree = _mini_tree(tmp_path)
+    cache = str(tmp_path / "cache.json")
+    run_paths([str(tree)], cache=cache)
+    with open(cache, encoding="utf-8") as fh:
+        data = json.load(fh)
+    assert data["rules_version"] == cache_mod.rules_version()
+    # A rules-set change (simulated version bump) must cold-start.
+    monkeypatch.setattr(cache_mod, "rules_version", lambda: "different")
+    lc = cache_mod.LintCache(cache)
+    assert lc.entries == {}
+
+
+def test_cache_corruption_degrades_to_cold(tmp_path):
+    tree = _mini_tree(tmp_path)
+    cache = str(tmp_path / "cache.json")
+    with open(cache, "w", encoding="utf-8") as fh:
+        fh.write("{not json")
+    assert run_paths([str(tree)], cache=cache) == run_paths([str(tree)])
+
+
+def test_cache_narrowed_rules_bypass(tmp_path):
+    """A rules-subset run must not poison (or be served by) the cache."""
+    from cuvite_tpu.analysis.rules import SubprocessNoTimeout
+
+    tree = _mini_tree(tmp_path)
+    cache = str(tmp_path / "cache.json")
+    run_paths([str(tree)], cache=cache)        # full registry, cached
+    only = run_paths([str(tree)], rules=[SubprocessNoTimeout()],
+                     cache=cache)
+    assert {f.rule for f in only} == {"R007"}
+    full = run_paths([str(tree)], cache=cache)
+    assert {f.rule for f in full} == {"R007"}
+
+
+# ---------------------------------------------------------------------------
+# Baseline hygiene: staleness report + --prune-baseline.
+
+
+def test_stale_baseline_entries_and_prune(tmp_path):
+    from cuvite_tpu.analysis.engine import (
+        prune_baseline,
+        stale_baseline_entries,
+    )
+
+    tree = _mini_tree(tmp_path)
+    bl = str(tmp_path / "bl.json")
+    findings = run_paths([str(tree)])
+    write_baseline(bl, findings)
+    # Fix the violation: the baseline entry goes stale.
+    (tree / "a.py").write_text(
+        (SUPPRESSIBLE % "").replace("subprocess.run(cmd)",
+                                    "subprocess.run(cmd, timeout=60)"))
+    now = run_paths([str(tree)])
+    stale = stale_baseline_entries(now, load_baseline(bl))
+    assert len(stale) == 1 and stale[0][0][1] == "R007"
+    dropped = prune_baseline(bl, now)
+    assert dropped == 1
+    assert load_baseline(bl) == {}
+    assert stale_baseline_entries(now, load_baseline(bl)) == []
+    assert prune_baseline(bl, now) == 0      # idempotent
+
+
+def test_prune_baseline_keeps_live_entries(tmp_path):
+    from cuvite_tpu.analysis.engine import prune_baseline
+
+    tree = _mini_tree(tmp_path)
+    bl = str(tmp_path / "bl.json")
+    findings = run_paths([str(tree)])
+    write_baseline(bl, findings)
+    assert prune_baseline(bl, findings) == 0
+    new, old = apply_baseline(run_paths([str(tree)]), load_baseline(bl))
+    assert new == [] and len(old) == len(findings)
+
+
+def test_prune_and_staleness_are_scoped_to_linted_paths(tmp_path):
+    """A subset run (lint.sh --changed, explicit paths) must treat
+    entries for UNLINTED files as unknown — neither stale-reported nor
+    pruned — or every subset run would steer the operator into deleting
+    live grandfathered slots."""
+    from cuvite_tpu.analysis.engine import (
+        linted_rels,
+        prune_baseline,
+        stale_baseline_entries,
+    )
+
+    tree = _mini_tree(tmp_path)
+    (tree / "c.py").write_text(SUPPRESSIBLE % "")   # second violation
+    bl = str(tmp_path / "bl.json")
+    write_baseline(bl, run_paths([str(tree)]))      # a.py + c.py slots
+    # Subset run over ONE file: c.py's live entry must survive.
+    subset = [str(tree / "a.py")]
+    findings = run_paths(subset)
+    linted = linted_rels(subset)
+    assert linted == {"tools/a.py"}
+    assert stale_baseline_entries(findings, load_baseline(bl),
+                                  linted=linted) == []
+    assert prune_baseline(bl, findings, linted=linted) == 0
+    new, old = apply_baseline(run_paths([str(tree)]), load_baseline(bl))
+    assert new == [] and len(old) == 2              # both still covered
+    # The same subset WITHOUT the scope would have reported/pruned it.
+    assert len(stale_baseline_entries(findings, load_baseline(bl))) == 1
+
+
+def test_prune_baseline_cli_refuses_no_project(tmp_path):
+    from cuvite_tpu.analysis.__main__ import main
+
+    tree = _mini_tree(tmp_path)
+    bl = str(tmp_path / "bl.json")
+    write_baseline(bl, run_paths([str(tree)]))
+    with pytest.raises(SystemExit):
+        main([str(tree), "--baseline", bl, "--prune-baseline",
+              "--no-project"])
+
+
+def test_prune_baseline_cli(tmp_path, capsys):
+    from cuvite_tpu.analysis.__main__ import main
+
+    tree = _mini_tree(tmp_path)
+    bl = str(tmp_path / "bl.json")
+    write_baseline(bl, run_paths([str(tree)]))
+    (tree / "a.py").write_text("x = 1\n")
+    rc = main([str(tree), "--baseline", bl, "--prune-baseline"])
+    assert rc == 0
+    assert "pruned 1 stale baseline slot(s)" in capsys.readouterr().out
+    assert load_baseline(bl) == {}
+
+
+def test_selflint_reports_stale_count_in_text(tmp_path, capsys):
+    from cuvite_tpu.analysis.__main__ import main
+
+    tree = _mini_tree(tmp_path)
+    bl = str(tmp_path / "bl.json")
+    write_baseline(bl, run_paths([str(tree)]))
+    (tree / "a.py").write_text("x = 1\n")
+    rc = main([str(tree), "--baseline", bl])
+    out = capsys.readouterr().out
+    assert rc == 0 and "stale baseline slot(s)" in out
+
+
+# ---------------------------------------------------------------------------
+# SARIF output: schema shape + round-trip against the finding list.
+
+
+def test_sarif_roundtrip(tmp_path, capsys):
+    from cuvite_tpu.analysis.__main__ import main, to_sarif
+
+    tree = _mini_tree(tmp_path)
+    rc = main([str(tree), "--format", "sarif"])
+    assert rc == 1                       # the R007 finding fails the gate
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["version"] == "2.1.0"
+    assert doc["$schema"].endswith("sarif-2.1.0.json")
+    run = doc["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert rule_ids >= set(RULE_IDS) | {"R017", "R018", "E000"}
+    findings = run_paths([str(tree)])
+    assert len(run["results"]) == len(findings)
+    for res, f in zip(run["results"],
+                      sorted(findings,
+                             key=lambda f: (f.path, f.line, f.rule))):
+        assert res["ruleId"] == f.rule
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == f.path
+        assert loc["region"]["startLine"] == f.line
+        assert loc["region"]["snippet"]["text"] == f.snippet
+        assert res["partialFingerprints"]["graftlintFingerprint/v1"]
+    # Fingerprints must be a pure function of (path, rule, snippet):
+    # regenerating from the same findings is byte-identical.
+    assert to_sarif(findings) == to_sarif(findings)
+    # Severity -> SARIF level mapping (R007 is high -> error).
+    assert run["results"][0]["level"] == "error"
+
+
+def test_sarif_baselined_findings_are_excluded(tmp_path, capsys):
+    from cuvite_tpu.analysis.__main__ import main
+
+    tree = _mini_tree(tmp_path)
+    bl = str(tmp_path / "bl.json")
+    write_baseline(bl, run_paths([str(tree)]))
+    rc = main([str(tree), "--format", "sarif", "--baseline", bl])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert doc["runs"][0]["results"] == []
+    assert doc["runs"][0]["properties"]["baselinedFindings"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Tier 3: jaxpr lint + compile-budget audit (the dynamic tier).  The
+# audit runs the REAL entries at the representative small class — the
+# same scenarios tools/compile_audit.py grades — plus the sabotage
+# fixture proving B002 actually catches content-in-the-compile-key.
+
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def test_compile_budget_audit_tier1(monkeypatch):
+    """tools/compile_audit.py must pass on the current repo: observed
+    compile set ⊆ the checked-in manifest, nothing recompiles on a
+    content-only change, and the traced jaxprs carry no 64-bit ops,
+    callbacks, or in-graph transfers."""
+    monkeypatch.chdir(REPO)
+    import compile_audit
+
+    results, jaxpr_findings = compile_audit.run_audit()
+    problems = [f.format() for r in results for f in r.findings]
+    problems += [f.format() for f in jaxpr_findings]
+    assert not problems, "\n".join(problems)
+
+
+def test_compile_audit_sabotage_content_in_compile_key():
+    """Thread batch content into a compile key (weights as a static
+    argument) and assert the budget auditor catches it — the gate that
+    replaces PR 10's by-hand measurement."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cuvite_tpu.analysis.jaxpr_audit import audit_entry
+
+    @functools.partial(jax.jit, static_argnames=("w",))
+    def sabotaged(x, *, w):
+        # content (the weight tuple) is a STATIC: every distinct batch
+        # recompiles — exactly what pinning weights f32 prevents.
+        return x * jnp.asarray(w, dtype=jnp.float32)
+
+    def run(seed):
+        w = tuple(float(v) for v in
+                  np.random.default_rng(seed).uniform(0.5, 2.0, 4))
+        sabotaged(np.ones(4, np.float32), w=w)
+
+    res = audit_entry("sabotage", run,
+                      {"modules": ["sabotaged"],
+                       "content_independent": True})
+    assert any(f.rule == "B002" for f in res.findings), res
+    assert not res.ok
+
+
+def test_compile_audit_missing_manifest_entry_fails_closed():
+    from cuvite_tpu.analysis.jaxpr_audit import audit_entry
+
+    res = audit_entry("ghost_entry", lambda seed: None, None)
+    assert [f.rule for f in res.findings] == ["B001"]
+
+
+def test_compile_audit_union_patterns_cover_shared_programs():
+    """Which entry a shared program's compile lands on depends on run
+    order (the serve path compiles the batched entries' programs when
+    audited alone): matching must accept the UNION of the manifest's
+    modules via extra_patterns, not just the entry's own."""
+    import jax
+    import numpy as np
+
+    from cuvite_tpu.analysis.jaxpr_audit import audit_entry
+
+    def shared_program(x):
+        return x - 1
+
+    jitted = jax.jit(shared_program)
+
+    def run(seed):
+        jitted(np.full(5, seed, np.float32))
+
+    alone = audit_entry("other_entry", run,
+                        {"modules": [], "content_independent": True})
+    assert any(f.rule == "B001" for f in alone.findings)
+    covered = audit_entry("other_entry", run,
+                          {"modules": [], "content_independent": True},
+                          extra_patterns=("shared_program",))
+    assert not [f for f in covered.findings if f.rule == "B001"]
+
+
+def test_compile_audit_unexpected_module_is_b001():
+    import jax
+    import numpy as np
+
+    from cuvite_tpu.analysis.jaxpr_audit import audit_entry
+
+    def interloper_program(x):
+        return x + 1
+
+    jitted = jax.jit(interloper_program)
+
+    def run(seed):
+        jitted(np.full(3, seed, np.float32))  # same shapes: one compile
+
+    res = audit_entry("closed_set", run,
+                      {"modules": ["something_else"],
+                       "content_independent": True})
+    rules = [f.rule for f in res.findings]
+    assert "B001" in rules and "B002" not in rules
+
+
+def test_jaxpr_lint_flags_wide_dtypes_and_callbacks():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cuvite_tpu.analysis.jaxpr_audit import lint_jaxpr
+
+    def clean(x):
+        return jnp.sum(x * 2)
+
+    jaxpr = jax.make_jaxpr(clean)(np.ones(8, np.float32))
+    assert lint_jaxpr(jaxpr, "clean") == []
+
+    def with_callback(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v),
+            jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+    jaxpr = jax.make_jaxpr(with_callback)(np.ones(8, np.float32))
+    hits = lint_jaxpr(jaxpr, "with_callback")
+    assert [f.rule for f in hits] == ["J002"]
+    assert hits[0].severity == "high"
+    assert lint_jaxpr(jaxpr, "with_callback", allow=("J002",)) == []
+
+
+def test_jaxpr_lint_recurses_into_subjaxprs():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cuvite_tpu.analysis.jaxpr_audit import lint_jaxpr
+
+    def body(c):
+        i, x = c
+        y = jax.pure_callback(
+            lambda v: np.asarray(v),
+            jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+        return i + 1, y
+
+    def looped(x):
+        return jax.lax.while_loop(lambda c: c[0] < 3, body, (0, x))
+
+    jaxpr = jax.make_jaxpr(looped)(jnp.ones(4, jnp.float32))
+    assert any(f.rule == "J002"
+               for f in lint_jaxpr(jaxpr, "looped"))

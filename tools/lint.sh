@@ -1,9 +1,38 @@
 #!/bin/sh
 # graftlint pre-commit one-liner: the EXACT gate tests/test_analysis.py
 # enforces in tier-1 (new high-severity finding anywhere in cuvite_tpu/,
-# tools/, or tests/ => exit 1).  Extra args pass through, e.g.:
+# tools/, or tests/ => exit 1), warm-started from the incremental cache
+# (tools/.graftlint_cache.json — bit-identical to a cold run; delete it
+# any time).  Extra args pass through, e.g.:
 #   tools/lint.sh --fail-on medium        # stricter local run
-#   tools/lint.sh --format json           # machine-readable findings
+#   tools/lint.sh --format json|sarif     # machine-readable findings
+#   tools/lint.sh --prune-baseline        # drop dead baseline entries
+#   tools/lint.sh --changed               # only files touched vs HEAD
+#                                         # (+ untracked) — the fast
+#                                         # pre-commit loop; a subset
+#                                         # run loses the cross-module
+#                                         # tier's full context, so run
+#                                         # the full gate before pushing
 # See ANALYSIS.md for the rule catalogue and suppression/baseline flow.
-cd "$(dirname "$0")/.." && exec python -m cuvite_tpu.analysis \
-    cuvite_tpu tools tests --baseline tools/graftlint_baseline.json "$@"
+cd "$(dirname "$0")/.." || exit 2
+if [ "$1" = "--changed" ]; then
+    shift
+    # --diff-filter=d: a DELETED file must not reach the linter (its
+    # path would fail closed with a high E000 'no Python files').
+    changed=$( { git diff --name-only --diff-filter=d HEAD -- \
+                     'cuvite_tpu/*.py' 'tools/*.py' 'tests/*.py'; \
+                 git ls-files --others --exclude-standard \
+                     'cuvite_tpu/*.py' 'tools/*.py' 'tests/*.py'; } \
+               | sort -u)
+    if [ -z "$changed" ]; then
+        echo "graftlint: no changed Python files under the gate paths; ok"
+        exit 0
+    fi
+    # shellcheck disable=SC2086 — word-splitting the file list is the point
+    exec python -m cuvite_tpu.analysis $changed \
+        --baseline tools/graftlint_baseline.json \
+        --cache tools/.graftlint_cache.json "$@"
+fi
+exec python -m cuvite_tpu.analysis cuvite_tpu tools tests \
+    --baseline tools/graftlint_baseline.json \
+    --cache tools/.graftlint_cache.json "$@"
