@@ -15,6 +15,12 @@ Layout (the three tiers, ANALYSIS.md "Tiers"):
   rules.py       — tier 1: the per-file lexical rule set (R001..R016)
   callgraph.py   — tier 2: cross-module jit-reachability (R017/R018)
   lockset.py     — tier 2b: serve/ lockset concurrency checker (R019)
+  lockorder.py   — tier 4 (static): lock-order cycles (R020) and
+                   check-then-act atomicity (R021) for serve/
+  concheck.py    — tier 4 (dynamic): deterministic-schedule concurrency
+                   checker — vector-clock race detection over the
+                   serve/sync.py cooperative scheduler; also runnable as
+                   python -m cuvite_tpu.analysis.concheck
   cache.py       — incremental lint cache (content-hash keyed)
   jaxpr_audit.py — tier 3: jaxpr lint + compile-budget audit (J*/B*
                    findings; driven by tools/compile_audit.py)
@@ -37,10 +43,12 @@ from cuvite_tpu.analysis.engine import (
 )
 
 # Importing the rule modules populates the registry as a side effect
-# (tier 1 lexical rules, tier 2 cross-module rules, tier 2b lockset).
+# (tier 1 lexical rules, tier 2 cross-module rules, tier 2b lockset,
+# tier 4 static lock-order/atomicity).
 from cuvite_tpu.analysis import rules as _rules        # noqa: F401
 from cuvite_tpu.analysis import callgraph as _cg       # noqa: F401
 from cuvite_tpu.analysis import lockset as _lockset    # noqa: F401
+from cuvite_tpu.analysis import lockorder as _lockord  # noqa: F401
 from cuvite_tpu.analysis.callgraph import (
     run_project,
     run_project_sources,

@@ -68,7 +68,7 @@ JIT_ENTRY_CALLS = set(_JIT_NAMES) | {
     "shard_map", "jax.experimental.shard_map.shard_map",
 }
 
-SUMMARY_VERSION = 3
+SUMMARY_VERSION = 4
 
 
 def module_of(rel: str) -> str:
@@ -264,6 +264,13 @@ def summarize(sf: SourceFile) -> dict:
             "sync_sites": facts["sync"],
             "pull_sites": facts["pull"],
         })
+    # Tier-4 static facts ride the same summary (and therefore the same
+    # incremental-cache entry): the R020 acquisition graph is rebuilt
+    # from cached lock summaries exactly like R017/R018 are from the
+    # dataflow ones.  Lazy import: lockorder subclasses ProjectRule from
+    # THIS module.
+    from cuvite_tpu.analysis import lockorder
+
     return {
         "version": SUMMARY_VERSION,
         "rel": sf.rel,
@@ -272,6 +279,7 @@ def summarize(sf: SourceFile) -> dict:
         "from_imports": from_imports,
         "entry_wraps": entry_wraps,
         "functions": funcs,
+        "locks": lockorder.lock_summary(sf),
         "suppress": {str(ln): sorted(ids)
                      for ln, ids in sf._line_suppress.items()},
         "file_suppress": sorted(sf._file_suppress),

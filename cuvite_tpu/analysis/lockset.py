@@ -127,7 +127,12 @@ def _annotations(sf) -> dict:
 
 
 class _ClassFacts:
-    """Lock regions, mutations, and declared fields of one class."""
+    """Lock regions, mutations, reads, and declared fields of one
+    class.  Shared infrastructure: R019 consumes the mutations, R021
+    (analysis/lockorder.py) additionally consumes the reads-in-test and
+    the retained held-map, and concheck's runtime instrumentation seeds
+    its shared-field inventory from :func:`lockset_summary` built on
+    these facts."""
 
     def __init__(self, sf, cls: ast.ClassDef, annotations: dict):
         self.cls = cls
@@ -139,10 +144,12 @@ class _ClassFacts:
         for node in ast.walk(cls):
             if isinstance(node, ast.ClassDef) and node is not cls:
                 nested.update(id(n) for n in ast.walk(node))
+        self._nested = nested
         # node-id -> set of lock ids held (lexically) at that node.
         held: dict = {}
         self.mutations: list = []   # (owner, field, verb, node, held, ctor)
         self.guards: dict = {}      # (owner, field) -> set of lock ids
+        self.declared: set = set()  # (owner, field) guards from pragmas
         for node in ast.walk(cls):
             if id(node) in nested:
                 continue
@@ -158,6 +165,7 @@ class _ClassFacts:
                     if inner is node:
                         continue
                     held.setdefault(id(inner), set()).update(locks)
+        self.held = held
         body_nodes = {id(n) for n in cls.body}  # class-body declarations
         for node in ast.walk(cls):
             if id(node) in nested:
@@ -197,10 +205,59 @@ class _ClassFacts:
             if lineno in decl_fields:
                 self.guards.setdefault(
                     ("self", decl_fields[lineno]), set()).add(lock)
+                self.declared.add(("self", decl_fields[lineno]))
                 continue
             for owner, field, _verb, node, _held, _ctor in self.mutations:
                 if node.lineno == lineno:
                     self.guards.setdefault((owner, field), set()).add(lock)
+                    self.declared.add((owner, field))
+
+    def reads_in_test(self, sf) -> list:
+        """(owner, field, node, held, func) for every Load of a dotted
+        ``owner.field`` inside an ``if``/``while`` TEST expression of
+        this class — the check-then-act shape R021 polices."""
+        out = []
+        for node in ast.walk(self.cls):
+            if id(node) in self._nested:
+                continue
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            for sub in ast.walk(node.test):
+                if not isinstance(sub, ast.Attribute) \
+                        or not isinstance(sub.ctx, ast.Load):
+                    continue
+                name = dotted(sub)
+                if not name or "." not in name:
+                    continue
+                owner, field = name.rsplit(".", 1)
+                out.append((owner, field, sub,
+                            self.held.get(id(sub), set()),
+                            sf.enclosing_function(node)))
+        return out
+
+
+def lockset_summary(sf) -> list:
+    """The file's guarded-field inventory as plain JSON: one entry per
+    (class, owner, field) whose lock discipline R019 establishes —
+    inferred from locked mutations or declared via ``guarded-by``
+    pragmas.  This is the shared-field inventory concheck's dynamic
+    instrumentation is seeded from (ISSUE 13), and the declared bit is
+    what its stale-annotation cross-check keys on."""
+    out = []
+    annotations = _annotations(sf)
+    for cls in sf.walk():
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        facts = _ClassFacts(sf, cls, annotations)
+        for (owner, field), locks in sorted(facts.guards.items()):
+            out.append({
+                "class": cls.name,
+                "owner": owner,
+                "field": field,
+                "locks": sorted(locks),
+                "declared": (owner, field) in facts.declared,
+            })
+    return out
 
 
 @register

@@ -13,7 +13,12 @@ shedding, deterministic fault injection with bounded
 exponential-backoff retry (faults.py), an async socket daemon with
 graceful SIGTERM drain (daemon.py), and an open-loop saturation load
 generator (loadgen.py).  Every deadline runs on the injectable clock
-(clock.py; graftlint R016).
+(clock.py; graftlint R016), and every lock/event/thread comes from the
+sync seam (sync.py): plain threading in production, a deterministic
+cooperative scheduler under the tier-4 concurrency checker
+(analysis/concheck.py — races, deadlocks, and lock-across-send
+regressions are machine-checked across seeded interleavings before
+they can reach a real dispatcher thread).
 
     python -m cuvite_tpu.serve demo --jobs 64 --b-max 16
     python -m cuvite_tpu.serve cluster-many a.vite b.vite ...

@@ -53,8 +53,8 @@ import json
 import os
 import re
 import socket
-import threading
 
+from cuvite_tpu.serve import sync
 from cuvite_tpu.serve.admission import AdmissionReject
 from cuvite_tpu.serve.queue import LouvainServer
 
@@ -108,8 +108,8 @@ class _Client:
         self.daemon = daemon
         self.conn = conn
         self.idx = idx
-        self.wlock = threading.Lock()
-        self.thread = threading.Thread(
+        self.wlock = sync.Lock()
+        self.thread = sync.Thread(
             target=self._read_loop, name=f"serve-client-{idx}", daemon=True)
 
     def send(self, payload: dict) -> bool:
@@ -187,15 +187,19 @@ class ServeDaemon:
                        else max(server.config.linger_s / 2.0, 0.005))
         self.io_timeout_s = io_timeout_s
         self.max_line_bytes = max_line_bytes
-        self.lock = threading.RLock()        # guards `server` wholesale
-        self._wake = threading.Event()       # submit -> dispatcher
-        self._drain_req = threading.Event()
-        self._done = threading.Event()
+        # Every primitive comes from serve/sync.py — the seam that lets
+        # concheck (graftlint tier 4) run this exact daemon under a
+        # deterministic cooperative scheduler; in production these ARE
+        # the plain threading primitives.
+        self.lock = sync.RLock()             # guards `server` wholesale
+        self._wake = sync.Event()            # submit -> dispatcher
+        self._drain_req = sync.Event()
+        self._done = sync.Event()
         self._listener: socket.socket | None = None
         self._clients: dict = {}
         self._routes: dict = {}     # job_id -> (client, want_labels)
-        self._accept_thread: threading.Thread | None = None
-        self._dispatch_thread: threading.Thread | None = None
+        self._accept_thread = None
+        self._dispatch_thread = None
         self.summary: dict | None = None
 
     # -- lifecycle ----------------------------------------------------------
@@ -214,9 +218,9 @@ class ServeDaemon:
         ls.listen(16)
         ls.settimeout(0.2)                    # accept loop polls the stop flag
         self._listener = ls
-        self._accept_thread = threading.Thread(
+        self._accept_thread = sync.Thread(
             target=self._accept_loop, name="serve-accept", daemon=True)
-        self._dispatch_thread = threading.Thread(
+        self._dispatch_thread = sync.Thread(
             target=self._dispatch_loop, name="serve-dispatch", daemon=True)
         self._accept_thread.start()
         self._dispatch_thread.start()

@@ -73,6 +73,7 @@ from cuvite_tpu.core.batch import (
 )
 from cuvite_tpu.core.types import TERMINATION_PHASE_COUNT
 from cuvite_tpu.serve import clock as serve_clock
+from cuvite_tpu.serve import sync
 from cuvite_tpu.serve.admission import (
     AdmissionConfig,
     AdmissionController,
@@ -257,8 +258,11 @@ class ServeStats:
     # enqueue->dispatch waits of the last WAIT_WINDOW jobs (seconds).
     wait_samples: collections.deque = dataclasses.field(  # graftlint: guarded-by=self.lock
         default_factory=lambda: collections.deque(maxlen=WAIT_WINDOW))
+    # sync.RLock is the serve/ synchronization seam: a plain
+    # threading.RLock in production, a scheduler-backed twin under the
+    # concheck cooperative scheduler (graftlint tier 4).
     lock: threading.RLock = dataclasses.field(
-        default_factory=threading.RLock, repr=False, compare=False)
+        default_factory=sync.RLock, repr=False, compare=False)
 
     @property
     def pack_util(self) -> float:

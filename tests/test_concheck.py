@@ -1,0 +1,727 @@
+"""Tier-4 concurrency checker tests (ISSUE 13): the cooperative
+scheduler seam, vector-clock race detection, the resurrected PR-12
+``_routes`` race (true positive) against the fixed daemon (true
+negative), the no-lock-across-send pin, drain racing a transient-retry
+backoff, the R020/R021 static rules, cache bit-identity for the static
+lock summaries, and the SARIF/env-knob plumbing.
+
+The dynamic tests run the REAL ServeDaemon code (handle/_dispatch_loop/
+request_drain) with the stub runner on the virtual clock — hundreds of
+distinct interleavings cost seconds and zero real sleeps; every failing
+schedule is replayable from its (strategy, seed) pair.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cuvite_tpu.analysis import concheck, run_paths, run_source
+from cuvite_tpu.analysis.callgraph import run_project_sources
+from cuvite_tpu.serve import sync
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# One serve_inventory() parse shared by every dynamic test in the file.
+INVENTORY = concheck.serve_inventory()
+
+
+def scenario(name: str) -> concheck.DaemonScenario:
+    s = concheck.builtin_scenarios()[name][0]()
+    s.inventory = INVENTORY
+    return s
+
+
+# ---------------------------------------------------------------------------
+# Inventory: seeded from the R019 lockset summaries
+
+
+def test_inventory_seeded_from_lockset_summaries():
+    fields = {(e["class"], e["field"]) for e in INVENTORY}
+    # the PR-12 race field and the declared ServeStats counters
+    assert ("ServeDaemon", "_routes") in fields
+    assert ("ServeStats", "jobs_done") in fields
+    assert ("ServeStats", "wait_samples") in fields
+    declared = {(e["class"], e["field"]) for e in INVENTORY
+                if e["declared"]}
+    assert ("ServeStats", "jobs_done") in declared
+    # inference-only entries carry declared=False
+    routes = [e for e in INVENTORY
+              if (e["class"], e["field"]) == ("ServeDaemon", "_routes")]
+    assert routes and not routes[0]["declared"]
+    assert routes[0]["locks"] == ["self.lock"]
+
+
+# ---------------------------------------------------------------------------
+# THE regression pin: the PR-12 _routes race, resurrected
+
+
+def test_routes_race_detected_within_default_budget():
+    """True positive: the lock-free _route_results pop racing intake's
+    locked check-then-insert MUST be convicted — and the failing
+    schedule must replay from its seed."""
+    rep = concheck.explore(scenario("racy-routes"), budget=32, seed=0,
+                           stop_on_failure=True)
+    assert not rep.clean, "the resurrected _routes race went undetected"
+    races = rep.races()
+    assert any(r["field"] == "ServeDaemon._routes" for r in races), races
+    race = next(r for r in races if r["field"] == "ServeDaemon._routes")
+    # both access stacks are reported, anchored in daemon code
+    for side in ("first", "second"):
+        assert race[side]["stack"], race
+        assert any("daemon.py" in frame[0]
+                   for frame in race[side]["stack"]), race[side]
+    # replay-from-seed: the SAME (strategy, seed) convicts again,
+    # deterministically, on a fresh scenario instance
+    failing = rep.failing[0]
+    replay = concheck.run_schedule(scenario("racy-routes"),
+                                   seed=failing.seed,
+                                   strategy=failing.strategy)
+    assert any(r["field"] == "ServeDaemon._routes" for r in replay.races)
+    assert replay.signature == failing.signature
+
+
+def test_fixed_daemon_clean_on_the_convicting_seeds():
+    """True negative: the shipped daemon (locked pops) explores clean
+    on the exact seeds that convict the racy variant."""
+    racy = concheck.explore(scenario("racy-routes"), budget=32, seed=0,
+                            stop_on_failure=True)
+    assert racy.failing
+    for failing in racy.failing[:2]:
+        rep = concheck.run_schedule(scenario("clean"), seed=failing.seed,
+                                    strategy=failing.strategy)
+        assert rep.clean, (rep.failures, rep.races)
+
+
+def test_clean_tree_conservation_across_200_interleavings():
+    """The acceptance gate: the current serve/ tree explores clean —
+    zero races, zero deadlocks, zero assertion failures — and job
+    conservation + exactly-once delivery hold across >= 200 DISTINCT
+    interleavings (every schedule's post-run check asserts them)."""
+    budget = max(concheck.schedule_budget(), 200)
+    rep = concheck.explore(scenario("clean"), budget=budget, seed=7)
+    assert rep.clean, (rep.failures()[:3], rep.races()[:3])
+    assert rep.schedules == budget
+    assert rep.distinct >= 200, \
+        f"only {rep.distinct} distinct interleavings explored"
+    assert not rep.warnings, rep.warnings
+
+
+def test_conservation_check_has_teeth():
+    """The per-schedule invariant check must actually convict a broken
+    ledger — tamper with a counter after a clean run and re-check."""
+    scen = scenario("clean")
+    det = concheck.RaceDetector()
+    sched = sync.Scheduler(seed=3, strategy="random", detector=det)
+    with sync.activated(sched):
+        ctx = scen.setup(sched)
+    sched.run()
+    scen.check(sched, ctx)
+    assert not sched.failures
+    with ctx["server"].stats.lock:
+        ctx["server"].stats.jobs_done += 1      # break the ledger
+    scen.check(sched, ctx)
+    assert any(f["kind"] == "conservation" for f in sched.failures)
+
+
+# ---------------------------------------------------------------------------
+# No lock held across a socket send (the PR-12 claim, pinned)
+
+
+def test_send_under_lock_is_convicted():
+    rep = concheck.explore(scenario("send-under-lock"), budget=16,
+                           seed=0, stop_on_failure=True)
+    assert not rep.clean
+    kinds = {f["kind"] for f in rep.failures()}
+    assert "lock-across-send" in kinds, kinds
+    msg = next(f for f in rep.failures()
+               if f["kind"] == "lock-across-send")["message"]
+    assert "ServeDaemon.lock" in msg
+
+
+def test_shipped_daemon_never_sends_under_a_lock():
+    rep = concheck.explore(scenario("clean"), budget=24, seed=11)
+    assert not any(f["kind"] == "lock-across-send"
+                   for f in rep.failures()), rep.failures()
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM drain racing a pending transient-retry backoff
+
+
+def test_drain_races_retry_backoff_terminates_exactly_once():
+    """device:transient:n=1 puts the dispatcher into a virtual-time
+    retry backoff; the drainer requests drain at an arbitrary point of
+    every schedule.  The retrying job must terminate exactly once,
+    conservation must hold, and the daemon must complete the drain —
+    all asserted per schedule by DaemonScenario.check.  At least one
+    explored schedule must interleave the drain REQUEST inside the
+    pending backoff window (the satellite's target interleaving)."""
+    scen = scenario("drain-vs-retry")
+    drain_during_backoff = 0
+    for i in range(24):
+        rep = concheck.run_schedule(scen, seed=900 + i,
+                                    strategy=("random", "pct")[i % 2])
+        assert rep.clean, (rep.seed, rep.failures, rep.races)
+        dispatch_sleep = drain_set = None
+        for step, (tname, op, detail) in enumerate(rep.trace):
+            if tname == "dispatch" and op == "sleep" \
+                    and dispatch_sleep is None:
+                dispatch_sleep = step
+            if tname == "drainer" and op == "set" \
+                    and "drain_req" in detail:
+                drain_set = step
+        if dispatch_sleep is not None and drain_set is not None \
+                and drain_set > dispatch_sleep:
+            drain_during_backoff += 1
+    assert drain_during_backoff >= 1, \
+        "no schedule interleaved the drain request with the retry " \
+        "backoff — the scenario lost its targeting"
+
+
+# ---------------------------------------------------------------------------
+# Vector-clock semantics (unit level)
+
+
+def _two_thread_run(body1, body2, *, seed=0, inventory=None):
+    det = concheck.RaceDetector()
+    sched = sync.Scheduler(seed=seed, strategy="random", detector=det)
+
+    class Shared:
+        def __init__(self):
+            self.lock = sync.Lock()
+            self.other_lock = sync.Lock()
+            self.ev = sync.Event()
+            self.x = 0
+
+    with sync.activated(sched):
+        obj = Shared()
+        obj.lock.name = "Shared.lock"
+        inv = inventory if inventory is not None else [
+            {"class": "Shared", "owner": "self", "field": "x",
+             "locks": ["self.lock"], "declared": False}]
+        concheck.instrument(sched, [obj], inv)
+        sched.spawn(body1, name="w1", args=(obj,))
+        sched.spawn(body2, name="w2", args=(obj,))
+    sched.run()
+    return sched, det, obj
+
+
+def test_vc_locked_increments_are_not_a_race():
+    def w(obj):
+        with obj.lock:
+            obj.x += 1
+
+    for seed in range(6):
+        sched, det, obj = _two_thread_run(w, w, seed=seed)
+        assert not det.races, det.races
+        assert not sched.failures
+        assert obj.x == 2
+
+
+def test_vc_unlocked_write_write_is_a_race():
+    def w(obj):
+        obj.x += 1
+
+    convicted = 0
+    for seed in range(6):
+        _sched, det, _obj = _two_thread_run(w, w, seed=seed)
+        convicted += bool(det.races)
+    # happens-before conviction does not depend on hitting the bad
+    # interleaving: EVERY schedule convicts
+    assert convicted == 6
+
+
+def test_vc_event_set_wait_orders_the_handoff():
+    """set() -> observed wait() is a happens-before edge: publish via
+    event, consume after wait — no race, in every schedule."""
+
+    def producer(obj):
+        obj.x = 41
+        obj.ev.set()
+
+    def consumer(obj):
+        if obj.ev.wait(timeout=10.0):
+            obj.x += 1
+
+    for seed in range(6):
+        _sched, det, obj = _two_thread_run(producer, consumer, seed=seed)
+        assert not det.races, (seed, det.races)
+        assert obj.x == 42
+
+
+def test_vc_mixed_lock_is_still_a_race():
+    """One side under lock A, the other under lock B: mutual exclusion
+    in name only — still unordered, still convicted."""
+
+    def w1(obj):
+        with obj.lock:
+            obj.x += 1
+
+    def w2(obj):
+        with obj.other_lock:
+            obj.x += 1
+
+    convicted = 0
+    for seed in range(6):
+        _sched, det, _obj = _two_thread_run(w1, w2, seed=seed)
+        convicted += bool(det.races)
+    assert convicted == 6
+
+
+def test_vc_event_clear_resets_the_hb_edge():
+    """Soundness: after clear(), a wait released by a LATER set must
+    join only that setter's clock — a stale event clock would fabricate
+    happens-before with the ORIGINAL setter and mask its race.  Virtual
+    sleeps pin the order: A writes+sets at t0, B (never synced with A)
+    clears at t0+1 and re-sets at t0+2, C waits at t0+3 and reads."""
+    det = concheck.RaceDetector()
+    sched = sync.Scheduler(seed=0, strategy="random", detector=det)
+
+    class Shared:
+        def __init__(self):
+            self.lock = sync.Lock()
+            self.ev = sync.Event()
+            self.x = 0
+
+    def a(obj):
+        obj.x = 1
+        obj.ev.set()
+
+    def b(obj):
+        sched.sleep(1.0)
+        obj.ev.clear()
+        sched.sleep(1.0)
+        obj.ev.set()
+
+    def c(obj):
+        sched.sleep(3.0)
+        if obj.ev.wait(timeout=10.0):
+            _ = obj.x
+
+    with sync.activated(sched):
+        obj = Shared()
+        concheck.instrument(sched, [obj], [
+            {"class": "Shared", "owner": "self", "field": "x",
+             "locks": ["self.lock"], "declared": False}])
+        sched.spawn(a, name="a", args=(obj,))
+        sched.spawn(b, name="b", args=(obj,))
+        sched.spawn(c, name="c", args=(obj,))
+    sched.run()
+    assert any(r["field"] == "Shared.x"
+               and {r["first"]["thread"], r["second"]["thread"]}
+               == {"a", "c"}
+               for r in det.races), det.races
+
+
+def test_lock_acquire_timeout_is_virtual():
+    """A timed acquire on a contended lock must expire on the VIRTUAL
+    clock and return False — not park forever (which would convict a
+    spurious deadlock on correct code)."""
+    sched = sync.Scheduler(seed=0, strategy="random")
+    out = {}
+
+    class Shared:
+        def __init__(self):
+            self.lock = sync.Lock()
+
+    def holder(obj):
+        with obj.lock:
+            sched.sleep(5.0)
+
+    def trier(obj):
+        sched.sleep(0.5)        # let the holder win the lock
+        out["got"] = obj.lock.acquire(timeout=1.0)
+
+    with sync.activated(sched):
+        obj = Shared()
+        sched.spawn(holder, name="holder", args=(obj,))
+        sched.spawn(trier, name="trier", args=(obj,))
+    sched.run()
+    assert out["got"] is False
+    assert not any(f["kind"] == "deadlock" for f in sched.failures), \
+        sched.failures
+
+
+def test_send_to_other_client_under_a_wlock_is_convicted():
+    """Cross-client head-of-line stall: holding client A's wlock across
+    a send to client B must be convicted — only the DESTINATION
+    client's own lock is exempt."""
+    sched = sync.Scheduler(seed=0, strategy="random")
+    with sync.activated(sched):
+        c0 = concheck.FakeClient(sched, 0)
+        c1 = concheck.FakeClient(sched, 1)
+
+        def broadcaster():
+            with c0.wlock:
+                c1.send({"result": {"job_id": "j0"}})
+
+        sched.spawn(broadcaster, name="bcast")
+    sched.run()
+    hits = [f for f in sched.failures if f["kind"] == "lock-across-send"]
+    assert hits and "_Client.wlock#0" in hits[0]["message"]
+
+
+def test_stale_guarded_by_annotation_warns():
+    """A declared guard the schedules never observe held is a stale
+    annotation: the static tier is being lied to."""
+
+    def w(obj):
+        with obj.other_lock:        # guards with the WRONG lock
+            obj.x += 1
+
+    inv = [{"class": "Shared", "owner": "self", "field": "x",
+            "locks": ["self.lock"], "declared": True}]
+    _sched, det, _obj = _two_thread_run(w, w, seed=0, inventory=inv)
+    warnings = det.warnings()
+    assert warnings and "stale guarded-by" in warnings[0]
+    assert "Shared.x" in warnings[0]
+
+
+def test_scheduler_detects_lock_order_deadlock():
+    """Opposite-order acquisition must be driven INTO the deadlock by
+    some schedule and reported with both threads' wait reasons."""
+
+    def w1(obj):
+        with obj.lock:
+            with obj.other_lock:
+                obj.x += 1
+
+    def w2(obj):
+        with obj.other_lock:
+            with obj.lock:
+                obj.x += 1
+
+    deadlocked = 0
+    for seed in range(24):
+        sched, _det, _obj = _two_thread_run(w1, w2, seed=seed)
+        deadlocked += any(f["kind"] == "deadlock"
+                          for f in sched.failures)
+    assert deadlocked >= 1, "no schedule drove the AB/BA deadlock"
+
+
+def test_replay_same_seed_same_schedule():
+    rep1 = concheck.run_schedule(scenario("clean"), seed=123,
+                                 strategy="pct")
+    rep2 = concheck.run_schedule(scenario("clean"), seed=123,
+                                 strategy="pct")
+    assert rep1.signature == rep2.signature
+    assert rep1.steps == rep2.steps
+    assert [t[:2] for t in rep1.trace] == [t[:2] for t in rep2.trace]
+
+
+def test_pct_strategy_explores_clean():
+    rep = concheck.explore(scenario("clean"), budget=8, seed=5,
+                           strategies=("pct",))
+    assert rep.clean, (rep.failures()[:3], rep.races()[:3])
+
+
+# ---------------------------------------------------------------------------
+# R021 — check-then-act atomicity (per-file static)
+
+
+R021_BAD = '''
+import threading
+
+class D:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self._routes = {}
+
+    def submit(self, rid, client):
+        if rid in self._routes:
+            return False
+        with self.lock:
+            self._routes[rid] = client
+        return True
+'''
+
+R021_GOOD = '''
+import threading
+
+class D:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self._routes = {}
+
+    def submit(self, rid, client):
+        with self.lock:
+            if rid in self._routes:
+                return False
+            self._routes[rid] = client
+        return True
+'''
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def test_r021_check_then_act_fires():
+    fs = [f for f in run_source(R021_BAD, rel="cuvite_tpu/serve/x.py")
+          if f.rule == "R021"]
+    assert len(fs) == 1
+    assert "check-then-act" in fs[0].message
+    assert fs[0].severity == "high"
+
+
+def test_r021_recheck_under_lock_is_clean():
+    assert "R021" not in rules_of(
+        run_source(R021_GOOD, rel="cuvite_tpu/serve/x.py"))
+
+
+def test_r021_scope_is_serve_only():
+    assert "R021" not in rules_of(
+        run_source(R021_BAD, rel="cuvite_tpu/louvain/x.py"))
+
+
+def test_r021_read_in_other_function_is_clean():
+    """The check-then-act shape needs the mutation in the SAME function
+    — a read-only helper deciding nothing it mutates is not a finding."""
+    src = R021_BAD.replace(
+        "        if rid in self._routes:\n            return False\n",
+        "")
+    src += '''
+    def peek(self, rid):
+        if rid in self._routes:
+            return True
+        return False
+'''
+    assert "R021" not in rules_of(
+        run_source(src, rel="cuvite_tpu/serve/x.py"))
+
+
+def test_r021_inline_suppression():
+    src = R021_BAD.replace(
+        "if rid in self._routes:",
+        "if rid in self._routes:  # graftlint: disable=R021")
+    assert "R021" not in rules_of(
+        run_source(src, rel="cuvite_tpu/serve/x.py"))
+
+
+# ---------------------------------------------------------------------------
+# R020 — lock-order cycles (project tier)
+
+
+R020_A = '''
+import threading
+
+class A:
+    def __init__(self, b: "B"):
+        self.lock = threading.Lock()
+        self.b = b
+
+    def m(self):
+        with self.lock:
+            self.b.poke()
+
+    def kick(self):
+        with self.lock:
+            pass
+'''
+
+R020_B = '''
+import threading
+
+class B:
+    def __init__(self, a: "A"):
+        self.lock = threading.Lock()
+        self.a = a
+
+    def poke(self):
+        with self.lock:
+            self.a.kick()
+'''
+
+
+def test_r020_cross_class_cycle_fires():
+    fs = run_project_sources({"cuvite_tpu/serve/a.py": R020_A,
+                              "cuvite_tpu/serve/b.py": R020_B})
+    hits = [f for f in fs if f.rule == "R020"]
+    assert hits, fs
+    assert any("A.lock" in f.message and "B.lock" in f.message
+               for f in hits) or any("re-acquired" in f.message
+                                     for f in hits)
+
+
+def test_r020_nested_with_cycle_and_consistent_order():
+    nest = '''
+class C:
+    def m1(self):
+        with self.a_lock:
+            with self.b_lock:
+                pass
+
+    def m2(self):
+        with self.b_lock:
+            with self.a_lock:
+                pass
+'''
+    fs = run_project_sources({"cuvite_tpu/serve/c.py": nest})
+    assert "R020" in rules_of(fs)
+    consistent = nest.replace(
+        "with self.b_lock:\n            with self.a_lock:",
+        "with self.a_lock:\n            with self.b_lock:")
+    fs = run_project_sources({"cuvite_tpu/serve/c.py": consistent})
+    assert "R020" not in rules_of(fs)
+
+
+def test_r020_nonreentrant_self_deadlock_vs_rlock():
+    src = '''
+import threading
+
+class S:
+    def __init__(self):
+        self.lock = threading.Lock()
+
+    def outer(self):
+        with self.lock:
+            self.inner()
+
+    def inner(self):
+        with self.lock:
+            pass
+'''
+    fs = run_project_sources({"cuvite_tpu/serve/s.py": src})
+    hits = [f for f in fs if f.rule == "R020"]
+    assert hits and "self-deadlock" in hits[0].message
+    fs = run_project_sources({
+        "cuvite_tpu/serve/s.py": src.replace("threading.Lock()",
+                                             "threading.RLock()")})
+    assert "R020" not in rules_of(fs)
+
+
+def test_r020_scope_is_serve_only():
+    fs = run_project_sources({"cuvite_tpu/louvain/a.py": R020_A,
+                              "cuvite_tpu/louvain/b.py": R020_B})
+    assert "R020" not in rules_of(fs)
+
+
+def test_r020_r021_self_lint_current_serve_tree_is_clean():
+    """The acceptance pin: zero R020/R021 findings on the shipped
+    serve/ package (the daemon's lock order is acyclic, every guarded
+    check re-checks under the lock)."""
+    fs = run_paths([os.path.join(REPO, "cuvite_tpu", "serve")])
+    assert not [f for f in fs if f.rule in ("R020", "R021")], \
+        [f.format() for f in fs if f.rule in ("R020", "R021")]
+
+
+# ---------------------------------------------------------------------------
+# Cache: static tier-4 outputs ride it; dynamic results never do
+
+
+def _serve_fixture_tree(tmp_path):
+    tree = tmp_path / "cuvite_tpu" / "serve"
+    tree.mkdir(parents=True)
+    (tree / "a.py").write_text(R020_A)
+    (tree / "b.py").write_text(R020_B)
+    (tree / "x.py").write_text(R021_BAD)
+    return tmp_path / "cuvite_tpu"
+
+
+def test_lock_summaries_ride_cache_warm_equals_cold(tmp_path):
+    tree = _serve_fixture_tree(tmp_path)
+    cache = str(tmp_path / "cache.json")
+    cold = run_paths([str(tree)])
+    assert {"R020", "R021"} <= rules_of(cold)
+    warm0 = run_paths([str(tree)], cache=cache)
+    warm1 = run_paths([str(tree)], cache=cache)   # pure hits
+    assert cold == warm0 == warm1                 # bit-identical
+    with open(cache, encoding="utf-8") as fh:
+        data = json.load(fh)
+    ent = data["entries"]["cuvite_tpu/serve/a.py"]
+    locks = ent["summary"]["locks"]
+    assert locks["classes"]["A"]["methods"]["m"]["acquires"]
+    # R020 findings are PROJECT findings rebuilt from the cached
+    # summaries — they are not stored per file
+    assert "R020" not in {f["rule"] for f in ent["findings"]}
+
+
+def test_dynamic_exploration_never_touches_the_cache(tmp_path):
+    tree = _serve_fixture_tree(tmp_path)
+    cache = str(tmp_path / "cache.json")
+    run_paths([str(tree)], cache=cache)
+    with open(cache, "rb") as fh:
+        before = fh.read()
+    rep = concheck.explore(scenario("clean"), budget=2, seed=0)
+    assert rep.clean
+    with open(cache, "rb") as fh:
+        assert fh.read() == before
+    # and nothing concheck-shaped leaked into the cache schema
+    assert b"races" not in before and b"schedules" not in before
+
+
+# ---------------------------------------------------------------------------
+# SARIF + CLI + env knob
+
+
+def test_r020_r021_emit_through_sarif(tmp_path, capsys):
+    from cuvite_tpu.analysis.__main__ import main
+
+    tree = _serve_fixture_tree(tmp_path)
+    rc = main([str(tree), "--format", "sarif"])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    run = doc["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"R020", "R021"} <= rule_ids
+    hit_ids = {r["ruleId"] for r in run["results"]}
+    assert {"R020", "R021"} <= hit_ids
+    for res in run["results"]:
+        assert res["partialFingerprints"]["graftlintFingerprint/v1"]
+        assert res["locations"][0]["physicalLocation"]["region"][
+            "startLine"] >= 1
+
+
+def test_sched_budget_env_knob(monkeypatch):
+    monkeypatch.setenv(concheck.BUDGET_ENV, "7")
+    assert concheck.schedule_budget() == 7
+    monkeypatch.setenv(concheck.BUDGET_ENV, "not-a-number")
+    with pytest.warns(UserWarning, match=concheck.BUDGET_ENV):
+        assert concheck.schedule_budget() == concheck.DEFAULT_BUDGET
+    monkeypatch.setenv(concheck.BUDGET_ENV, "0")
+    with pytest.warns(UserWarning):
+        assert concheck.schedule_budget() == concheck.DEFAULT_BUDGET
+    monkeypatch.delenv(concheck.BUDGET_ENV)
+    assert concheck.schedule_budget() == concheck.DEFAULT_BUDGET
+
+
+def test_concheck_cli_main_inprocess():
+    rc = concheck.main(["--budget", "2", "--seed", "0",
+                        "--scenario", "racy-routes", "--format", "json"])
+    assert rc == 0      # expect=detect and it WAS detected
+
+
+def test_concheck_cli_rejects_unknown_scenario():
+    with pytest.raises(SystemExit):
+        concheck.main(["--scenario", "bogus"])
+
+
+def test_concheck_cli_replay_reproduces_a_conviction():
+    """The CLI's printed replay handle must actually reproduce: replay
+    the racy fixture from the (strategy, seed) pair explore found."""
+    rep = concheck.explore(scenario("racy-routes"), budget=16, seed=0,
+                           stop_on_failure=True)
+    failing = rep.failing[0]
+    rc = concheck.main(["--scenario", "racy-routes", "--replay",
+                        f"{failing.strategy}:{failing.seed}"])
+    assert rc == 1      # the replayed schedule convicts again
+    # and a clean scenario replays clean on the same handle
+    rc = concheck.main(["--scenario", "clean", "--replay",
+                        f"{failing.strategy}:{failing.seed}"])
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_concheck_cli_subprocess_smoke():
+    """The lint.sh --sched-smoke entry: real child process, fixed seed,
+    tiny budget — clean scenarios clean, bug fixtures convicted."""
+    out = subprocess.run(
+        [sys.executable, "-m", "cuvite_tpu.analysis.concheck",
+         "--budget", "3", "--seed", "0"],
+        capture_output=True, text=True, cwd=REPO, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "concheck: ok" in out.stdout

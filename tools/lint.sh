@@ -13,8 +13,27 @@
 #                                         # run loses the cross-module
 #                                         # tier's full context, so run
 #                                         # the full gate before pushing
+#   tools/lint.sh --sched-smoke           # tier-4 concheck self-check:
+#                                         # a small FIXED-seed schedule
+#                                         # budget over the daemon
+#                                         # scenarios (clean ones must
+#                                         # explore clean, the known-bug
+#                                         # fixtures must be convicted).
+#                                         # CUVITE_SCHED_BUDGET raises
+#                                         # the budget; extra args pass
+#                                         # through (--scenario, --seed,
+#                                         # --format json).  Dynamic
+#                                         # results are never cached.
 # See ANALYSIS.md for the rule catalogue and suppression/baseline flow.
 cd "$(dirname "$0")/.." || exit 2
+if [ "$1" = "--sched-smoke" ]; then
+    shift
+    # Forced-CPU like tier-1: the harness stubs the batch runner, but
+    # the serve import chain initializes a jax backend.
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" export JAX_PLATFORMS
+    exec python -m cuvite_tpu.analysis.concheck \
+        --budget "${CUVITE_SCHED_BUDGET:-8}" --seed 0 "$@"
+fi
 if [ "$1" = "--changed" ]; then
     shift
     # --diff-filter=d: a DELETED file must not reach the linter (its
