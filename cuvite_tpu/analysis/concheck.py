@@ -565,14 +565,20 @@ def _send_under_lock_route_results(self, finished, fails, sheds):
 
 class DaemonScenario:
     """One explorable daemon workload: intake threads driving the real
-    ``handle``, the real dispatcher loop, a stats poller, and a drainer
-    — conservation and exactly-once checked after every schedule."""
+    ``handle``, the real dispatcher (the serial loop, or the pipelined
+    packer/executor seam-thread pair — ISSUE 14), a stats poller, and a
+    drainer — conservation and exactly-once checked after every
+    schedule.  ``pack_hold_s`` injects a virtual-clock sleep INSIDE the
+    pack stage (a schedule point mid-pack), so schedules can interleave
+    a drain request with an in-flight pack — the
+    ``drain-vs-inflight-pack`` target."""
 
     def __init__(self, name: str, *, n_intake: int = 2, jobs_each: int = 2,
                  fault_plan: str | None = None, variant=None,
                  drain_after_s: float = 0.03, with_ids: bool = False,
                  b_max: int = 2, linger_s: float = 0.02,
-                 max_retries: int = 2, retry_base_s: float = 0.05):
+                 max_retries: int = 2, retry_base_s: float = 0.05,
+                 pipelined: bool = False, pack_hold_s: float = 0.0):
         self.name = name
         self.n_intake = n_intake
         self.jobs_each = jobs_each
@@ -584,6 +590,8 @@ class DaemonScenario:
         self.linger_s = linger_s
         self.max_retries = max_retries
         self.retry_base_s = retry_base_s
+        self.pipelined = pipelined
+        self.pack_hold_s = pack_hold_s
         self.inventory = None   # filled by explore()/run_schedule()
 
     def setup(self, sched) -> dict:
@@ -599,10 +607,21 @@ class DaemonScenario:
             faults=FaultPlan.parse(self.fault_plan),
             runner=_stub_runner)
         daemon = ServeDaemon(server, sock_path="<concheck>",
-                             poll_s=0.01)
+                             poll_s=0.01, pipelined=self.pipelined)
         for attr in ("_wake", "_drain_req", "_done"):
             getattr(daemon, attr).name = f"ServeDaemon.{attr}"
         daemon.lock.name = "ServeDaemon.lock"
+        if self.pack_hold_s:
+            # The hold runs on the server's (scheduler) sleep: a
+            # schedule point inside the pack window, BEFORE the real
+            # pack — every interleaving of drain-vs-pack is reachable.
+            orig_pack = server.pack_batch
+
+            def holding_pack(jobs, key, trigger, now):
+                server.sleep(self.pack_hold_s)
+                return orig_pack(jobs, key, trigger, now)
+
+            server.pack_batch = holding_pack
         if self.variant is not None:
             daemon._route_results = types.MethodType(self.variant, daemon)
         inventory = self.inventory or serve_inventory()
@@ -624,8 +643,15 @@ class DaemonScenario:
             sched.sleep(self.drain_after_s)
             daemon.request_drain()
 
-        daemon._dispatch_thread = sched.spawn(
-            daemon._dispatch_loop, name="dispatch")
+        if self.pipelined:
+            pipe = daemon.pipe
+            pipe.handoff._cond.lock.name = "Handoff.lock"
+            daemon._dispatch_thread = sched.spawn(
+                pipe._exec_loop, name="executor")
+            pipe.pack_thread = sched.spawn(pipe._pack_loop, name="packer")
+        else:
+            daemon._dispatch_thread = sched.spawn(
+                daemon._dispatch_loop, name="dispatch")
         for i, client in enumerate(clients):
             sched.spawn(intake, name=f"intake{i}", args=(
                 client, _graph_reqs(self.jobs_each, f"t{i}",
@@ -798,6 +824,24 @@ def builtin_scenarios() -> dict:
             "drain-vs-retry", n_intake=1, jobs_each=2,
             fault_plan="device:transient:n=1", drain_after_s=0.06,
             retry_base_s=0.08), "clean"),
+        # ISSUE 14 — the pipelined dispatcher: packer + executor seam
+        # threads, intake, stats poller and drainer all interleaved.
+        "pipeline-clean": (lambda: DaemonScenario(
+            "pipeline-clean", n_intake=2, jobs_each=2, with_ids=True,
+            pipelined=True), "clean"),
+        "pipeline-faulty": (lambda: DaemonScenario(
+            "pipeline-faulty", n_intake=2, jobs_each=2, pipelined=True,
+            fault_plan="device:transient:n=1;pack:transient:n=1"),
+            "clean"),
+        # Drain requested while a pack is IN FLIGHT (pack_hold_s parks
+        # the packer mid-pack at a schedule point; the drain deadline
+        # lands INSIDE that virtual hold window): the packed batch must
+        # flush through the handoff slot exactly once, then the bins —
+        # never dropped, never executed twice.
+        "drain-vs-inflight-pack": (lambda: DaemonScenario(
+            "drain-vs-inflight-pack", n_intake=1, jobs_each=2,
+            pipelined=True, pack_hold_s=0.05, drain_after_s=0.02,
+            linger_s=0.01), "clean"),
         "racy-routes": (lambda: DaemonScenario(
             "racy-routes", variant=_racy_route_results), "detect"),
         "send-under-lock": (lambda: DaemonScenario(

@@ -1,4 +1,5 @@
-"""graftlint rule set R001..R016 (see ANALYSIS.md for the catalogue).
+"""graftlint per-file rule set R001..R016 + R022 (see ANALYSIS.md for
+the catalogue; R017-R021 live in the project-tier modules).
 
 Each rule targets a hazard class this codebase has actually hit (or is
 one refactor away from hitting): host syncs inside jitted code, jit
@@ -1098,6 +1099,70 @@ _SERVE_CLOCK_MODULE = "cuvite_tpu/serve/clock.py"
 # spelling of monotonic (a bare `time()` call is left out: it is far
 # more likely to be a local callable than the stdlib clock).
 _WALL_CLOCK_CALLS = {"time.monotonic", "time.time", "monotonic"}
+
+
+@register
+class ServeThreadingOutsideSeam(Rule):
+    id = "R022"
+    severity = "high"
+    title = "threading primitive constructed directly in serve/ " \
+            "outside the sync seam"
+
+    # The seam module itself is the ONE sanctioned construction site.
+    _SEAM = "cuvite_tpu/serve/sync.py"
+    _PRIMS = ("Thread", "Lock", "RLock", "Event", "Condition",
+              "Semaphore", "BoundedSemaphore", "Barrier")
+
+    def check(self, sf):
+        # R022 (ISSUE 14): every lock/event/thread the serving layer
+        # creates must come from serve/sync.py's factories — a plain
+        # threading.X in production AND a scheduler-backed twin under
+        # the concheck cooperative scheduler (graftlint tier 4).  A
+        # direct `threading.Lock()` in serve/ silently EXITS that
+        # seam: the daemon still works, but concheck can no longer
+        # serialize or replay schedules through the primitive, so the
+        # exact race/deadlock classes tier 4 exists to catch go back
+        # to reviewer vigilance.  PR 13 made the seam a convention;
+        # this rule makes it a checked invariant.
+        if not sf.rel.startswith(_SERVE_SCOPE) or sf.rel == self._SEAM:
+            return
+        aliases = {"threading"}
+        bare: set = set()
+        for node in sf.walk():
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "threading":
+                        aliases.add(a.asname or "threading")
+            elif isinstance(node, ast.ImportFrom) \
+                    and node.module == "threading":
+                for a in node.names:
+                    if a.name in self._PRIMS:
+                        bare.add(a.asname or a.name)
+        for node in sf.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted(node.func)
+            if fname is None:
+                continue
+            hit = None
+            if "." in fname:
+                mod, _, attr = fname.rpartition(".")
+                if mod in aliases and attr in self._PRIMS:
+                    hit = fname
+            elif fname in bare:
+                hit = fname
+            if hit is None:
+                continue
+            yield self.finding(
+                sf, node,
+                f"{hit}() constructed directly in a serve/ module: "
+                "serve/ synchronization primitives must come from the "
+                "serve/sync.py factories (sync.Lock/RLock/Event/"
+                "Condition/Thread) so the concheck cooperative "
+                "scheduler (graftlint tier 4) can serialize, replay "
+                "and race-check them; a raw threading primitive is "
+                "invisible to every tier-4 schedule — use the seam, "
+                "or justify with an inline '# graftlint: disable=R022'")
 
 
 @register

@@ -354,6 +354,11 @@ class BatchResult:
     # The serving-coarse class phases >= 1 ran at (engine='bucketed'
     # whose post-phase-0 batch fit `_coarse_class`), else None.
     coarse_class: tuple | None = None
+    # Pipeline-stage split of wall_s (ISSUE 14): host pack + upload vs
+    # compiled-program execution — the two stages the pipelined
+    # dispatcher overlaps (steady-state batch period = max, not sum).
+    pack_s: float = 0.0
+    device_s: float = 0.0
 
     @property
     def pack_util(self) -> float:
@@ -409,41 +414,54 @@ def _batch_accum_name(batch: BatchedSlab) -> str:
     return names.pop() if names else "float32"
 
 
-def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
-                max_phases: int = TERMINATION_PHASE_COUNT,
-                mesh="auto", tracer=None, verbose: bool = False,
-                engine: str = "fused", bucket_shape=None) -> BatchResult:
-    """Cluster every row of a packed batch; one compile per
-    (class, B, engine), one host sync per phase, one final label gather.
+@dataclasses.dataclass
+class PreparedBatch:
+    """A packed batch with its device buffers ALREADY uploaded — the
+    handoff unit of the pipelined dispatcher (ISSUE 14): the packer
+    stage builds one of these (host pack + plan build + upload) while
+    the executor stage runs the previous batch's compiled program
+    (:func:`execute_prepared`).  The initial device refs are never
+    mutated by execution, so a transient device fault can re-run
+    ``execute_prepared`` on the same PreparedBatch and get bit-identical
+    results without re-packing."""
 
-    Per-row semantics match the fused single-shard driver's plain
-    schedule at a fixed ``threshold``: phases run until a row's gain
-    drops below it (that row masks out), every row's reported Q is its
-    last gaining phase's in-loop value.  ``PhaseStats.seconds`` is the
-    batch phase wall split evenly over the rows active in that phase —
-    per-tenant wall is an AMORTIZED share, which is the serving-truth
-    number (the batch really did cost one wall interval).
+    # Host metadata (what the phase loop needs from the BatchedSlab).
+    b_pad: int
+    nv_pad: int
+    ne_pad: int
+    n_jobs: int
+    slab_class: tuple
+    nv_real: np.ndarray
+    ne_real: np.ndarray
+    row_valid: np.ndarray
+    # Statics of the compiled program set.
+    adt: str
+    coalesce: str
+    mesh: object
+    engine: str
+    n_buckets: int
+    # Device refs (phase-0 state; plans None for engine='fused').
+    src_d: object = None
+    dst_d: object = None
+    w_d: object = None
+    rm_d: object = None
+    const_d: object = None
+    comm_all_d: object = None
+    prev_d: object = None
+    plan_d: object = None
+    # Host pack + upload wall seconds (the packer-stage cost).
+    pack_s: float = 0.0
 
-    ``engine``: ``'fused'`` — every phase through the vmapped fused
-    loop; ``'bucketed'`` — phase 0 (the bulk of the per-row edge mass)
-    through the vmapped sort-free bucketed step over cross-graph-padded
-    plans built here at pack time (``batch_bucket_plans``); later
-    phases keep the fused loop.  ``bucket_shape`` pins the plan
-    geometry (``core.batch.BucketShape``) so many batches share one
-    compiled phase-0 program; None derives it from this batch.
 
-    ``mesh``: ``'auto'`` shards the batch axis over the largest usable
-    pow2 device count (:func:`make_batch_mesh`); ``None`` pins the
-    single-device program; or pass an explicit 1-D ``Mesh`` over
-    ``BATCH_AXIS``.  Sharding never changes per-row results — the
-    program has no cross-row op — only which device runs which rows.
-    """
+def prepare_batch(batch: BatchedSlab, *, mesh="auto", engine: str = "fused",
+                  bucket_shape=None, tracer=None) -> PreparedBatch:
+    """The PACK half of :func:`run_batched`: validate the batch's
+    statics, build the bucket plans (engine='bucketed'), resolve the
+    batch mesh, and upload every device buffer (``plan``/``upload``
+    stages, HBM-ledger tracked).  Contains no compiled-program
+    execution — in the pipelined dispatcher this runs on the packer
+    thread while the executor thread runs the previous batch."""
     from cuvite_tpu.core.batch import batch_bucket_plans
-    from cuvite_tpu.louvain.driver import (
-        LouvainResult,
-        PhaseStats,
-        _phase_sync,
-    )
 
     if engine not in BATCH_ENGINES:
         raise ValueError(f"unknown batched engine {engine!r}; "
@@ -456,26 +474,20 @@ def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
     t0 = time.perf_counter()
     B = batch.b_pad
     nv_pad = batch.nv_pad
-    cur_nv, cur_ne = nv_pad, batch.ne_pad  # slab class of the NEXT phase
-    coarse_class = None
     wdt = np.dtype(np.float32)
     adt = _batch_accum_name(batch)
     eng = _batched_coalesce_engine(nv_pad, adt)
     if mesh == "auto":
         mesh = make_batch_mesh(B)
-    phase_fn = _get_batched_phase(mesh, nv_pad, adt, eng,
-                                  MAX_TOTAL_ITERATIONS)
     bplan = None
-    phase0_fn = None
+    n_buckets = 0
     if engine == "bucketed":
         # Plans are built AT PACK TIME, before any device work — the
         # plan-per-job trap (building them inside a dispatch loop) is
         # what graftlint R015 guards against in serve/.
         with tracer.stage("plan"):
             bplan = batch_bucket_plans(batch, shape=bucket_shape)
-        phase0_fn = _get_batched_phase(
-            mesh, nv_pad, adt, eng, MAX_TOTAL_ITERATIONS,
-            engine="bucketed", n_buckets=len(bplan.buckets))
+        n_buckets = len(bplan.buckets)
 
     def _place(x):
         if mesh is None:
@@ -499,9 +511,9 @@ def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
             # verts cast to the device vertex dtype; weights stay f32
             # (the plan builder's stable-compile-key contract — see
             # core/batch.py); every array shards on the batch axis like
-            # the slab.  plan_d is deliberately the ONLY reference to
-            # the device plan buffers, so dropping it after phase 0
-            # really frees them.
+            # the slab.  The execute loop drops ITS plan reference after
+            # phase 0; the PreparedBatch keeps this one so a transient
+            # device fault can re-run execution without re-uploading.
             plan_d = (
                 tuple((_place(v.astype(np.int32)), _place(d), _place(ww))
                       for v, d, ww in bplan.buckets),
@@ -511,11 +523,63 @@ def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
             )
             bplan = None  # the host-side plan copy is dead weight too
 
-    active = np.asarray(batch.row_valid).copy()
+    return PreparedBatch(
+        b_pad=B, nv_pad=nv_pad, ne_pad=batch.ne_pad, n_jobs=batch.n_jobs,
+        slab_class=batch.slab_class, nv_real=batch.nv_real.copy(),
+        ne_real=batch.ne_real.copy(),
+        row_valid=np.asarray(batch.row_valid).copy(),
+        adt=adt, coalesce=eng, mesh=mesh, engine=engine,
+        n_buckets=n_buckets,
+        src_d=src_d, dst_d=dst_d, w_d=w_d, rm_d=rm_d, const_d=const_d,
+        comm_all_d=comm_all_d, prev_d=prev_d, plan_d=plan_d,
+        pack_s=time.perf_counter() - t0,
+    )
+
+
+def execute_prepared(prep: PreparedBatch, *, threshold: float = 1.0e-6,
+                     max_phases: int = TERMINATION_PHASE_COUNT,
+                     tracer=None, verbose: bool = False) -> BatchResult:
+    """The EXECUTE half of :func:`run_batched`: run the compiled
+    per-phase programs over an uploaded batch (one host sync per phase,
+    one final label gather).  Re-runnable: the PreparedBatch's device
+    refs are read-only here, so a retry restarts from phase 0 with
+    bit-identical results."""
+    from cuvite_tpu.louvain.driver import (
+        LouvainResult,
+        PhaseStats,
+        _phase_sync,
+    )
+
+    if tracer is None:
+        from cuvite_tpu.utils.trace import NullTracer
+
+        tracer = NullTracer()
+
+    t0 = time.perf_counter()
+    B = prep.b_pad
+    nv_pad = prep.nv_pad
+    cur_nv, cur_ne = nv_pad, prep.ne_pad  # slab class of the NEXT phase
+    coarse_class = None
+    wdt = np.dtype(np.float32)
+    adt = prep.adt
+    eng = prep.coalesce
+    mesh = prep.mesh
+    phase_fn = _get_batched_phase(mesh, nv_pad, adt, eng,
+                                  MAX_TOTAL_ITERATIONS)
+    phase0_fn = None
+    if prep.engine == "bucketed":
+        phase0_fn = _get_batched_phase(
+            mesh, nv_pad, adt, eng, MAX_TOTAL_ITERATIONS,
+            engine="bucketed", n_buckets=prep.n_buckets)
+    src_d, dst_d, w_d = prep.src_d, prep.dst_d, prep.w_d
+    rm_d, const_d = prep.rm_d, prep.const_d
+    comm_all_d, prev_d, plan_d = prep.comm_all_d, prep.prev_d, prep.plan_d
+
+    active = prep.row_valid.copy()
 
     # Host-side per-row bookkeeping.
-    nv_cur = batch.nv_real.copy()
-    ne_cur = batch.ne_real.copy()
+    nv_cur = prep.nv_real.copy()
+    ne_cur = prep.ne_real.copy()
     tot_iters = np.zeros(B, dtype=np.int64)
     row_phases: list = [[] for _ in range(B)]
     row_conv: list = [[] for _ in range(B)]
@@ -591,7 +655,7 @@ def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
             & (tot_iters <= MAX_TOTAL_ITERATIONS)
         if verbose:
             print(f"batched phase {phase}: active {int(active.sum())}/"
-                  f"{batch.n_jobs}, iters {iters_h[:batch.n_jobs]}")
+                  f"{prep.n_jobs}, iters {iters_h[:prep.n_jobs]}")
         tracer.ledger_snapshot(phase)
         if bucketed_phase:
             # The phase-0 plans are dead weight from here on (coarse
@@ -619,11 +683,11 @@ def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
     # batch; comm_all rows are already dense (composed through the
     # per-phase device renumber).
     comm_all_h, prev_h = jax.device_get((comm_all_d, prev_d))  # graftlint: disable=R010 — the allowlisted final label gather (batched)
-    wall = time.perf_counter() - t0
+    device_s = time.perf_counter() - t0
 
     results = []
-    for i in range(batch.n_jobs):
-        nv = int(batch.nv_real[i])
+    for i in range(prep.n_jobs):
+        nv = int(prep.nv_real[i])
         results.append(LouvainResult(
             communities=np.asarray(comm_all_h[i, :nv], dtype=np.int64),
             modularity=float(prev_h[i]),
@@ -633,10 +697,120 @@ def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
             convergence=row_conv[i],
         ))
     return BatchResult(
-        results=results, wall_s=wall, n_phases=phase, b_pad=B,
-        n_jobs=batch.n_jobs, slab_class=batch.slab_class,
+        results=results, wall_s=prep.pack_s + device_s, n_phases=phase,
+        b_pad=B, n_jobs=prep.n_jobs, slab_class=prep.slab_class,
         phase_engines=phase_engines, coarse_class=coarse_class,
+        pack_s=prep.pack_s, device_s=device_s,
     )
+
+
+def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
+                max_phases: int = TERMINATION_PHASE_COUNT,
+                mesh="auto", tracer=None, verbose: bool = False,
+                engine: str = "fused", bucket_shape=None) -> BatchResult:
+    """Cluster every row of a packed batch; one compile per
+    (class, B, engine), one host sync per phase, one final label gather.
+    Composition of the two pipeline halves —
+    ``execute_prepared(prepare_batch(batch))`` — so the serial path and
+    the pipelined dispatcher run the exact same code (ISSUE 14).
+
+    Per-row semantics match the fused single-shard driver's plain
+    schedule at a fixed ``threshold``: phases run until a row's gain
+    drops below it (that row masks out), every row's reported Q is its
+    last gaining phase's in-loop value.  ``PhaseStats.seconds`` is the
+    batch phase wall split evenly over the rows active in that phase —
+    per-tenant wall is an AMORTIZED share, which is the serving-truth
+    number (the batch really did cost one wall interval).
+
+    ``engine``: ``'fused'`` — every phase through the vmapped fused
+    loop; ``'bucketed'`` — phase 0 (the bulk of the per-row edge mass)
+    through the vmapped sort-free bucketed step over cross-graph-padded
+    plans built at pack time (``batch_bucket_plans``); later phases
+    keep the fused loop.  ``bucket_shape`` pins the plan geometry
+    (``core.batch.BucketShape``) so many batches share one compiled
+    phase-0 program; None derives it from this batch.
+
+    ``mesh``: ``'auto'`` shards the batch axis over the largest usable
+    pow2 device count (:func:`make_batch_mesh`); ``None`` pins the
+    single-device program; or pass an explicit 1-D ``Mesh`` over
+    ``BATCH_AXIS``.  Sharding never changes per-row results — the
+    program has no cross-row op — only which device runs which rows.
+    """
+    prep = prepare_batch(batch, mesh=mesh, engine=engine,
+                         bucket_shape=bucket_shape, tracer=tracer)
+    return execute_prepared(prep, threshold=threshold,
+                            max_phases=max_phases, tracer=tracer,
+                            verbose=verbose)
+
+
+@dataclasses.dataclass
+class PreparedMany:
+    """A :func:`cluster_many` job set after the PACK stage: the
+    edgeless jobs' inline answers plus the uploaded PreparedBatch for
+    the rest (None when every job was edgeless).  ``execute_many``
+    turns it into the full in-order BatchResult."""
+
+    graphs_nv: list          # num_vertices per input, in order
+    edgeless: set            # input indices answered inline
+    prep: PreparedBatch | None
+
+    @property
+    def pack_s(self) -> float:
+        return self.prep.pack_s if self.prep is not None else 0.0
+
+
+def pack_many(graphs, *, b_pad: int | None = None,
+              slab_class: tuple | None = None, mesh="auto",
+              engine: str = "fused", bucket_shape=None,
+              tracer=None) -> PreparedMany:
+    """The PACK stage of :func:`cluster_many`: edgeless split + slab
+    stacking + plan build + device upload.  Jax work is upload-only —
+    no compiled program runs here, which is what lets the pipelined
+    dispatcher overlap this with the previous batch's execution."""
+    if tracer is None:
+        from cuvite_tpu.utils.trace import NullTracer
+
+        tracer = NullTracer()
+    edgeless = {i for i, g in enumerate(graphs) if g.num_edges == 0}
+    packed = [g for i, g in enumerate(graphs) if i not in edgeless]
+    prep = None
+    if packed:
+        with tracer.stage("plan"):
+            batch = batch_slabs(packed, b_pad=b_pad,
+                                slab_class=slab_class)
+        prep = prepare_batch(batch, mesh=mesh, engine=engine,
+                             bucket_shape=bucket_shape, tracer=tracer)
+    return PreparedMany(graphs_nv=[g.num_vertices for g in graphs],
+                        edgeless=edgeless, prep=prep)
+
+
+def execute_many(pm: PreparedMany, *, threshold: float = 1.0e-6,
+                 max_phases: int = TERMINATION_PHASE_COUNT,
+                 tracer=None, verbose: bool = False) -> BatchResult:
+    """The EXECUTE stage of :func:`cluster_many`: run the prepared
+    batch and reassemble the in-order results list (edgeless jobs
+    answered inline, costing no batch rows)."""
+    from cuvite_tpu.louvain.driver import LouvainResult
+
+    if pm.prep is not None:
+        br = execute_prepared(pm.prep, threshold=threshold,
+                              max_phases=max_phases, tracer=tracer,
+                              verbose=verbose)
+    else:
+        br = BatchResult(results=[], wall_s=0.0, n_phases=0, b_pad=0,
+                         n_jobs=0, slab_class=(0, 0))
+    out = []
+    packed_iter = iter(br.results)
+    for i, nv in enumerate(pm.graphs_nv):
+        if i in pm.edgeless:
+            out.append(LouvainResult(
+                communities=np.arange(nv, dtype=np.int64),
+                modularity=0.0, phases=[], total_iterations=0,
+                total_seconds=0.0))
+        else:
+            out.append(next(packed_iter))
+    br.results = out
+    return br
 
 
 def cluster_many(graphs, *, threshold: float = 1.0e-6,
@@ -650,34 +824,14 @@ def cluster_many(graphs, *, threshold: float = 1.0e-6,
     returned ``results`` list covers EVERY input in order;
     ``n_jobs``/``pack_util``/``jobs_per_s`` describe only the PACKED
     batch (inline-answered edgeless jobs cost no batch rows).
+    Composition of :func:`pack_many` + :func:`execute_many` — the two
+    stages the pipelined dispatcher runs on separate threads.
     ``engine``/``bucket_shape``: see :func:`run_batched`."""
-    from cuvite_tpu.louvain.driver import LouvainResult
-
     if tracer is None:
         from cuvite_tpu.utils.trace import NullTracer
 
         tracer = NullTracer()
-    edgeless = {i for i, g in enumerate(graphs) if g.num_edges == 0}
-    packed = [g for i, g in enumerate(graphs) if i not in edgeless]
-    if packed:
-        with tracer.stage("plan"):
-            batch = batch_slabs(packed, b_pad=b_pad,
-                                slab_class=slab_class)
-        br = run_batched(batch, threshold=threshold, max_phases=max_phases,
-                         mesh=mesh, tracer=tracer, verbose=verbose,
-                         engine=engine, bucket_shape=bucket_shape)
-    else:
-        br = BatchResult(results=[], wall_s=0.0, n_phases=0, b_pad=0,
-                         n_jobs=0, slab_class=(0, 0))
-    out = []
-    packed_iter = iter(br.results)
-    for i, g in enumerate(graphs):
-        if i in edgeless:
-            out.append(LouvainResult(
-                communities=np.arange(g.num_vertices, dtype=np.int64),
-                modularity=0.0, phases=[], total_iterations=0,
-                total_seconds=0.0))
-        else:
-            out.append(next(packed_iter))
-    br.results = out
-    return br
+    pm = pack_many(graphs, b_pad=b_pad, slab_class=slab_class, mesh=mesh,
+                   engine=engine, bucket_shape=bucket_shape, tracer=tracer)
+    return execute_many(pm, threshold=threshold, max_phases=max_phases,
+                        tracer=tracer, verbose=verbose)

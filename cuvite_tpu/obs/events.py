@@ -110,18 +110,33 @@ class JsonlTraceSink(TraceSink):
 class SpanEmitter:
     """Nested-span bookkeeping over a sink: monotonically increasing span
     ids, a parent stack, and the (host, phase) tags every record carries.
-    Single-threaded by design — the drivers emit from the host control
-    loop only (device work is traced via the compile/profiler hooks, not
-    from inside jit)."""
+    The drivers emit from the host control loop only (device work is
+    traced via the compile/profiler hooks, not from inside jit).
+
+    Thread-aware since ISSUE 14: the pipelined dispatcher's packer and
+    executor stages emit concurrently, so the parent stack is
+    PER-THREAD (a packer's ``pack`` span can never adopt the executor's
+    events, and ending a span only unwinds the ending thread's own
+    stack) and id allocation + sink emission serialize under one lock
+    (interleaved records stay well-formed JSONL).  Single-threaded
+    callers see the exact pre-ISSUE-14 behavior."""
 
     def __init__(self, sink: TraceSink, host: int = 0):
+        import threading
+
         self.sink = sink
         self.host = int(host)
         self.phase = None
         self._next_id = 1
-        self._stack: list[int] = []
+        self._stacks: dict = {}     # thread ident -> [span ids]
         self._open: set[int] = set()
+        self._lock = threading.Lock()
         self._emit_base("run_begin", v=TRACE_VERSION)
+
+    def _stack_here(self) -> list:
+        import threading
+
+        return self._stacks.setdefault(threading.get_ident(), [])
 
     def _emit_base(self, t: str, **fields) -> None:
         rec = {"t": t, "wall": time.time(), "mono": time.perf_counter(),
@@ -132,46 +147,61 @@ class SpanEmitter:
         self.sink.emit(rec)
 
     def begin(self, name: str, **attrs) -> int:
-        sid = self._next_id
-        self._next_id += 1
-        parent = self._stack[-1] if self._stack else None
-        self._emit_base("span_begin", id=sid, parent=parent, name=name,
-                        attrs=jsonable(attrs))
-        self._stack.append(sid)
-        self._open.add(sid)
+        stack = self._stack_here()
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+            parent = stack[-1] if stack else None
+            self._emit_base("span_begin", id=sid, parent=parent, name=name,
+                            attrs=jsonable(attrs))
+            self._open.add(sid)
+        stack.append(sid)
         return sid
 
     def end(self, sid: int, dur_s: float | None = None, **attrs) -> None:
-        if sid not in self._open:
-            # Stale or double-ended handle: dropping it beats unwinding
-            # the whole open stack as "leaked" over one bad caller.
-            return
-        # Close any nested spans left open by a non-local exit first, so
-        # "every span closes" holds even on an exception path.
-        while self._stack and self._stack[-1] != sid:
-            leaked = self._stack.pop()
-            self._open.discard(leaked)
-            self._emit_base("span_end", id=leaked, leaked=True)
-        if self._stack and self._stack[-1] == sid:
-            self._stack.pop()
-        self._open.discard(sid)
-        rec = {"id": sid}
-        if dur_s is not None:
-            rec["dur_s"] = float(dur_s)
-        if attrs:
-            rec["attrs"] = jsonable(attrs)
-        self._emit_base("span_end", **rec)
+        stack = self._stack_here()
+        with self._lock:
+            if sid not in self._open:
+                # Stale, double-ended, or another thread's handle:
+                # dropping it beats unwinding this thread's open stack
+                # as "leaked" over one bad caller.
+                return
+            # Close any nested spans left open by a non-local exit
+            # first (THIS thread's only), so "every span closes" holds
+            # even on an exception path.
+            while stack and stack[-1] != sid:
+                leaked = stack.pop()
+                self._open.discard(leaked)
+                self._emit_base("span_end", id=leaked, leaked=True)
+            if stack and stack[-1] == sid:
+                stack.pop()
+            self._open.discard(sid)
+            rec = {"id": sid}
+            if dur_s is not None:
+                rec["dur_s"] = float(dur_s)
+            if attrs:
+                rec["attrs"] = jsonable(attrs)
+            self._emit_base("span_end", **rec)
 
     def event(self, name: str, **attrs) -> None:
-        parent = self._stack[-1] if self._stack else None
-        self._emit_base("event", name=name, parent=parent,
-                        attrs=jsonable(attrs))
+        stack = self._stack_here()
+        with self._lock:
+            parent = stack[-1] if stack else None
+            self._emit_base("event", name=name, parent=parent,
+                            attrs=jsonable(attrs))
 
     def close(self) -> None:
-        while self._stack:
-            self.end(self._stack[-1])
-        self._emit_base("run_end")
-        self.sink.close()
+        with self._lock:
+            # Unwind every thread's leftover spans (the emitter's
+            # "every span closes" guarantee, now per-thread).
+            for stack in self._stacks.values():
+                while stack:
+                    sid = stack.pop()
+                    if sid in self._open:
+                        self._open.discard(sid)
+                        self._emit_base("span_end", id=sid)
+            self._emit_base("run_end")
+            self.sink.close()
 
 
 def read_trace(path: str) -> list[dict]:

@@ -12,7 +12,12 @@ structured ``retry_after_s`` rejections (admission.py), deadline
 shedding, deterministic fault injection with bounded
 exponential-backoff retry (faults.py), an async socket daemon with
 graceful SIGTERM drain (daemon.py), and an open-loop saturation load
-generator (loadgen.py).  Every deadline runs on the injectable clock
+generator (loadgen.py).  Dispatch is a two-stage pipeline (ISSUE 14,
+pipeline.py): a packer thread builds + uploads batch k+1 while an
+executor thread runs batch k's compiled program, bridged by a depth-1
+handoff slot — steady-state batch period max(pack_s, device_s) instead
+of their sum — and the admission estimator's measured service curves
+drive per-class ``b_max`` autotuning (admission.py::BmaxAutotuner).  Every deadline runs on the injectable clock
 (clock.py; graftlint R016), and every lock/event/thread comes from the
 sync seam (sync.py): plain threading in production, a deterministic
 cooperative scheduler under the tier-4 concurrency checker
@@ -29,18 +34,23 @@ from cuvite_tpu.serve.admission import (
     AdmissionConfig,
     AdmissionController,
     AdmissionReject,
+    AutotuneConfig,
+    BmaxAutotuner,
 )
 from cuvite_tpu.serve.daemon import ServeDaemon
 from cuvite_tpu.serve.faults import FaultPlan, InjectedFault
+from cuvite_tpu.serve.pipeline import PipelinedDispatcher
 from cuvite_tpu.serve.queue import (
     Job,
     LouvainServer,
+    PackedBatch,
     ServeConfig,
     ServeStats,
 )
 
 __all__ = [
     "AdmissionConfig", "AdmissionController", "AdmissionReject",
-    "FaultPlan", "InjectedFault", "Job", "LouvainServer", "ServeConfig",
-    "ServeDaemon", "ServeStats",
+    "AutotuneConfig", "BmaxAutotuner", "FaultPlan", "InjectedFault",
+    "Job", "LouvainServer", "PackedBatch", "PipelinedDispatcher",
+    "ServeConfig", "ServeDaemon", "ServeStats",
 ]

@@ -83,6 +83,20 @@ def _build_parser() -> argparse.ArgumentParser:
                        help="transient-fault retry budget per dispatch")
         q.add_argument("--retry-base-ms", type=float, default=50.0,
                        help="retry backoff base (doubles per attempt)")
+        q.add_argument("--pipeline", default="on", choices=["on", "off"],
+                       help="two-stage pipelined dispatch (ISSUE 14): "
+                            "host pack of batch k+1 overlaps device "
+                            "execution of batch k ('on', the default); "
+                            "'off' keeps the serial single-dispatcher "
+                            "loop (the A/B arm).  Results are "
+                            "bit-identical either way")
+        q.add_argument("--autotune-b-max", action="store_true",
+                       help="per-class b_max autotuning from the "
+                            "measured service curve (needs "
+                            "--wait-slo-ms): after a warm window each "
+                            "class serves at the BATCH_SIZES rung "
+                            "maximizing projected goodput under the "
+                            "SLO, capped at --b-max")
 
     d = sub.add_parser("demo", help="synthetic multi-tenant load")
     common(d)
@@ -125,7 +139,8 @@ def _make_server(args):
         b_max=args.b_max, linger_s=args.linger_ms / 1e3,
         threshold=args.threshold, engine=args.engine,
         admission=admission, max_retries=args.max_retries,
-        retry_base_s=args.retry_base_ms / 1e3)
+        retry_base_s=args.retry_base_ms / 1e3,
+        autotune_b_max=bool(getattr(args, "autotune_b_max", False)))
     return config, faults, LouvainServer
 
 
@@ -168,7 +183,8 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
         daemon = ServeDaemon(server, sock_path=args.socket,
-                             host=args.host, port=args.port)
+                             host=args.host, port=args.port,
+                             pipelined=args.pipeline == "on")
         with rec_ctx:
             daemon.start()
             for sig in (signal.SIGTERM, signal.SIGINT):
@@ -179,6 +195,8 @@ def main(argv=None) -> int:
                 "socket": args.socket, "port": daemon.port,
                 "b_max": config.b_max, "engine": config.engine,
                 "admission": config.admission is not None,
+                "pipelined": daemon.pipelined,
+                "autotune": config.autotune_b_max,
                 "fault_plan": faults.spec()}}), flush=True)
             summary = daemon.serve_forever()
         print(json.dumps({"serve_summary": summary}), flush=True)

@@ -153,3 +153,90 @@ class AdmissionController:
             return None
         est = self.estimate(key) * self.config.headroom
         return max(projected - self.config.wait_slo_s, est)
+
+
+@dataclasses.dataclass
+class AutotuneConfig:
+    """Knobs of the measured-service ``b_max`` autotuner.  ``min_obs``
+    is the per-rung warm window: a rung is a candidate only after that
+    many batches DISPATCHED AT IT have been measured — which also means
+    its compiled program already exists, so retuning onto it can never
+    trigger a fresh XLA compile inside a bench's guard window (the
+    rung-candidacy rule IS the compile clamp)."""
+
+    min_obs: int = 3
+    window: int = 16
+
+    def __post_init__(self) -> None:
+        if self.min_obs < 1:
+            raise ValueError(f"min_obs must be >= 1, got {self.min_obs}")
+        if self.window < self.min_obs:
+            raise ValueError(
+                f"window ({self.window}) must be >= min_obs "
+                f"({self.min_obs})")
+
+
+class BmaxAutotuner:
+    """Per-class ``b_max`` selection from MEASURED service curves
+    (ISSUE 14): instead of trusting the ``ServeConfig.b_max`` constant,
+    pick the BATCH_SIZES rung that maximizes projected goodput
+    ``rung / est_batch_s(rung)`` among the rungs the class can serve
+    INSIDE the wait SLO.  The curve comes from the same injectable-clock
+    service observations the admission estimator keeps, separated by
+    the rung the batch actually dispatched at (open-loop traffic
+    naturally samples several rungs via linger/drain partials).
+
+    Feasibility mirrors the admission projection: a rung whose
+    headroom-scaled batch service exceeds the SLO would force every job
+    that queues behind ONE full batch past its wait target — a default
+    ``b_max=64`` whose batch costs seconds against a 500 ms SLO is the
+    motivating misconfiguration.  When no measured rung is feasible the
+    tuner falls back to the fastest measured one (least-infeasible:
+    strictly better than staying on a slower rung).
+
+    Candidates are clamped to rungs with >= ``min_obs`` observations —
+    i.e. rungs whose programs are measured AND compiled — so a retune
+    never selects a program that would compile fresh mid-serve."""
+
+    def __init__(self, admission: AdmissionConfig,
+                 config: AutotuneConfig | None = None):
+        self.slo_s = admission.wait_slo_s
+        self.headroom = admission.headroom
+        self.config = config or AutotuneConfig()
+        # (class key, rung) -> deque of batch service seconds
+        self._obs: dict = {}
+
+    def observe(self, key, rung: int, busy_s: float) -> None:
+        """One dispatched batch of ``rung`` padded rows took ``busy_s``
+        (pack + execute, on the injectable clock)."""
+        if rung < 1:
+            return
+        obs = self._obs.get((key, rung))
+        if obs is None:
+            obs = self._obs[(key, rung)] = collections.deque(
+                maxlen=self.config.window)
+        obs.append(busy_s)
+
+    def curve(self, key) -> dict:
+        """The measured service curve: {rung: median batch seconds} over
+        rungs past their warm window (the candidate set)."""
+        out = {}
+        for (k, rung), obs in self._obs.items():
+            if k == key and len(obs) >= self.config.min_obs:
+                out[rung] = statistics.median(obs)
+        return out
+
+    def pick(self, key, cap: int) -> int | None:
+        """The goodput-optimal measured rung <= ``cap`` (None before
+        any rung clears its warm window).  SLO-feasible rungs
+        (``est * headroom <= slo``) compete on projected goodput
+        ``rung / est``; with none feasible the fastest measured rung
+        wins (least-infeasible)."""
+        curve = {r: est for r, est in self.curve(key).items() if r <= cap}
+        if not curve:
+            return None
+        feasible = {r: est for r, est in curve.items()
+                    if est * self.headroom <= self.slo_s}
+        if feasible:
+            return max(feasible, key=lambda r: r / max(feasible[r], 1e-9))
+        return min(curve, key=curve.get)

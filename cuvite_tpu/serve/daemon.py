@@ -11,12 +11,16 @@ into a long-lived service:
     makes the stats lock a real requirement), ``drain`` (programmatic
     graceful shutdown, same path as SIGTERM).
 
-  * **Dispatcher** — ONE thread owns ``LouvainServer.step()``; it
-    wakes on submit or every ``poll_s`` (to fire linger deadlines) and
-    routes each finished/failed/shed job back to the connection that
-    submitted it.  All server state is guarded by one lock: intake
-    mutates the queue only under it, so the dispatcher's view is
-    always consistent.
+  * **Dispatcher** — a two-stage PIPELINE by default (ISSUE 14,
+    serve/pipeline.py): a packer thread pops due batches under the
+    daemon lock and packs + uploads them OUTSIDE it, while an executor
+    thread runs the previous batch's compiled program and routes each
+    finished/failed/shed job back to the connection that submitted it
+    — batch k+1's host pack overlaps batch k's device execution.
+    ``pipelined=False`` keeps the serial loop: ONE thread owning
+    ``LouvainServer.step()``, waking on submit or every ``poll_s``.
+    Either way, queue mutation happens only under the daemon lock, so
+    intake and the dispatcher always see a consistent queue.
 
   * **Graceful drain** — ``request_drain()`` (wired to SIGTERM/SIGINT
     by the CLI) closes intake, flushes every queued bin via
@@ -176,7 +180,7 @@ class ServeDaemon:
     def __init__(self, server: LouvainServer, *, sock_path: str | None = None,
                  host: str = "127.0.0.1", port: int | None = None,
                  poll_s: float | None = None, io_timeout_s: float = 10.0,
-                 max_line_bytes: int = 64 << 20):
+                 max_line_bytes: int = 64 << 20, pipelined: bool = True):
         if (sock_path is None) == (port is None):
             raise ValueError("exactly one of sock_path / port required")
         self.server = server
@@ -187,6 +191,7 @@ class ServeDaemon:
                        else max(server.config.linger_s / 2.0, 0.005))
         self.io_timeout_s = io_timeout_s
         self.max_line_bytes = max_line_bytes
+        self.pipelined = bool(pipelined)
         # Every primitive comes from serve/sync.py — the seam that lets
         # concheck (graftlint tier 4) run this exact daemon under a
         # deterministic cooperative scheduler; in production these ARE
@@ -201,6 +206,25 @@ class ServeDaemon:
         self._accept_thread = None
         self._dispatch_thread = None
         self.summary: dict | None = None
+        # Pipelined dispatch (ISSUE 14, the default): the packer and
+        # executor seam-threads replace the single dispatcher; they
+        # share THIS daemon's lock/wake/drain events so the submit-vs-
+        # drain recheck invariant spans both architectures.  The serial
+        # loop (_dispatch_loop) stays selectable for A/Bs.
+        self.pipe = None
+        if self.pipelined:
+            from cuvite_tpu.serve.pipeline import PipelinedDispatcher
+
+            # route looks _route_results up LATE (per call), so an
+            # instance-level replacement — concheck's seeded-bug
+            # variants monkeypatch exactly this method — reaches the
+            # pipelined path the same way it reaches the serial loop's
+            # dynamic attribute lookup.
+            self.pipe = PipelinedDispatcher(
+                server, lock=self.lock, wake=self._wake,
+                drain_req=self._drain_req, poll_s=self.poll_s,
+                route=lambda *a: self._route_results(*a),
+                on_done=self._finalize)
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -220,10 +244,15 @@ class ServeDaemon:
         self._listener = ls
         self._accept_thread = sync.Thread(
             target=self._accept_loop, name="serve-accept", daemon=True)
-        self._dispatch_thread = sync.Thread(
-            target=self._dispatch_loop, name="serve-dispatch", daemon=True)
         self._accept_thread.start()
-        self._dispatch_thread.start()
+        if self.pipe is not None:
+            self.pipe.start()
+            self._dispatch_thread = self.pipe.exec_thread
+        else:
+            self._dispatch_thread = sync.Thread(
+                target=self._dispatch_loop, name="serve-dispatch",
+                daemon=True)
+            self._dispatch_thread.start()
 
     def request_drain(self) -> None:
         """Begin graceful shutdown (idempotent; signal-handler safe:
@@ -237,6 +266,8 @@ class ServeDaemon:
         self._done.wait(timeout)
         if not self._done.is_set():
             raise TimeoutError("daemon did not drain within the timeout")
+        if self.pipe is not None and self.pipe.pack_thread is not None:
+            self.pipe.pack_thread.join(timeout=10.0)
         self._dispatch_thread.join(timeout=10.0)
         return self.summary
 
@@ -382,6 +413,9 @@ class ServeDaemon:
                                          "late_s": round(late_s, 6)}})
 
     def _dispatch_loop(self) -> None:
+        """The SERIAL dispatcher (pipelined=False): one thread owns the
+        whole pack+execute lifecycle under the daemon lock — the
+        pre-ISSUE-14 architecture, kept for the pipeline A/B."""
         server = self.server
         while True:
             self._wake.wait(timeout=self.poll_s)
@@ -390,17 +424,21 @@ class ServeDaemon:
             with self.lock:
                 finished = (server.drain() if draining
                             else server.step())
-                # Terminal reports with no result object: the daemon
-                # CONSUMES these (clears them after copying) — a
-                # long-lived service under sustained shedding or a
-                # standing fault plan must not grow them unboundedly.
-                fails = list(server.failures)
-                server.failures.clear()
-                sheds = list(server.shed)
-                server.shed.clear()
+            # Terminal reports with no result object: the daemon
+            # CONSUMES these (consume_terminal copies + clears) — a
+            # long-lived service under sustained shedding or a standing
+            # fault plan must not grow them unboundedly.
+            fails, sheds = server.consume_terminal()
             self._route_results(finished, fails, sheds)
             if draining and server.pending() == 0:
                 break
+        self._finalize()
+
+    def _finalize(self) -> None:
+        """Drain epilogue (both architectures; runs on the executor /
+        dispatcher thread): emit the serve_summary, notify clients,
+        unblock serve_forever."""
+        server = self.server
         summary = dict(server.stats.to_dict(),
                        conservation=self.server.conservation())
         server.tracer.event("serve_summary", **summary)

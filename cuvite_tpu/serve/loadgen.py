@@ -77,7 +77,8 @@ class LoadReport:
 
 def run_open_loop(server: LouvainServer, graphs, rate: float, *,
                   tenants: int = 1, deadline_s: float | None = None,
-                  max_wall_s: float = 3600.0) -> LoadReport:
+                  max_wall_s: float = 3600.0,
+                  pipelined: bool = False) -> LoadReport:
     """Offer ``graphs`` to ``server`` at ``rate`` jobs/s (open loop),
     then drain; the server must be FRESH (stats start at zero).
 
@@ -86,9 +87,21 @@ def run_open_loop(server: LouvainServer, graphs, rate: float, *,
     deadline to every job (the shedding path).  ``max_wall_s`` bounds
     a pathological run on the server's clock (e.g. a misconfigured
     rate of 1e-9) — it raises rather than spins forever.
+
+    ``pipelined`` (ISSUE 14) drives the server through the two-stage
+    PipelinedDispatcher (serve/pipeline.py) instead of the in-loop
+    ``step()`` calls: host pack of batch k+1 overlaps device execution
+    of batch k, the pipeline A/B's measured arm.  Pipelined runs need
+    the REAL clock/sleep pair (the seam threads block on production
+    primitives); fake-clock tests drive the serial path or the concheck
+    scheduler.
     """
     if rate <= 0:
         raise ValueError(f"rate must be > 0 jobs/s, got {rate}")
+    if pipelined:
+        return _run_open_loop_pipelined(
+            server, graphs, rate, tenants=tenants, deadline_s=deadline_s,
+            max_wall_s=max_wall_s)
     clock, sleep = server.clock, server.sleep
     poll_s = max(min(server.config.linger_s / 2.0, 0.01), 1e-4)
     finished: list = []
@@ -140,11 +153,56 @@ def run_open_loop(server: LouvainServer, graphs, rate: float, *,
         stats=stats, results=finished, conservation=cons)
 
 
+def _run_open_loop_pipelined(server: LouvainServer, graphs, rate: float, *,
+                             tenants: int, deadline_s: float | None,
+                             max_wall_s: float) -> LoadReport:
+    """The pipelined arm of :func:`run_open_loop`: submissions feed the
+    PipelinedDispatcher's intake lock; the packer/executor seam-threads
+    do the dispatching; the report is assembled after a full drain."""
+    from cuvite_tpu.serve.pipeline import PipelinedDispatcher
+
+    clock, sleep = server.clock, server.sleep
+    pipe = PipelinedDispatcher(
+        server, poll_s=max(min(server.config.linger_s / 2.0, 0.01), 1e-3))
+    pipe.start()
+    rejected = 0
+    t0 = clock()
+    n = len(graphs)
+    for i, g in enumerate(graphs):
+        target = t0 + i / rate
+        now = clock()
+        if target > now:
+            sleep(target - now)
+        try:
+            pipe.submit(g, tenant=f"t{i % tenants}",
+                        deadline_s=deadline_s, t_submit=target)
+        except AdmissionReject:
+            rejected += 1
+    pipe.request_drain()
+    if not pipe.wait_done(timeout=max_wall_s):
+        raise TimeoutError(
+            f"pipelined open-loop run exceeded max_wall_s={max_wall_s}")
+    wall = clock() - t0
+    stats = server.stats.to_dict()
+    cons = server.conservation()
+    with server.stats.lock:
+        samples = list(server.stats.wait_samples)
+    return LoadReport(
+        rate=rate, offered=n, done=stats["jobs_done"],
+        failed=stats["jobs_failed"], rejected=rejected,
+        shed=stats["jobs_shed"], wall_s=wall,
+        goodput_jobs_per_s=stats["jobs_done"] / max(wall, 1e-9),
+        wait_p50_s=percentile(samples, 50.0),
+        wait_p95_s=percentile(samples, 95.0),
+        stats=stats, results=pipe.results, conservation=cons)
+
+
 def saturation_sweep(make_server, make_graphs, *, start_rate: float,
                      slo_s: float, growth: float = 1.6,
                      max_rounds: int = 8, sustain_frac: float = 0.9,
                      tenants: int = 1,
-                     deadline_s: float | None = None) -> tuple:
+                     deadline_s: float | None = None,
+                     pipelined: bool = False) -> tuple:
     """Geometric arrival-rate ramp; stops at the first UNSUSTAINABLE
     rate (goodput < sustain_frac * rate, or wait p95 past the SLO).
 
@@ -159,7 +217,8 @@ def saturation_sweep(make_server, make_graphs, *, start_rate: float,
     rate = start_rate
     for _ in range(max_rounds):
         rep = run_open_loop(make_server(), make_graphs(), rate,
-                            tenants=tenants, deadline_s=deadline_s)
+                            tenants=tenants, deadline_s=deadline_s,
+                            pipelined=pipelined)
         reports.append(rep)
         sustainable = (rep.goodput_jobs_per_s >= sustain_frac * rate
                        and rep.wait_p95_s <= slo_s

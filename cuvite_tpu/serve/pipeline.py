@@ -1,0 +1,218 @@
+"""Pipelined dispatch: overlap host pack with device execution.
+
+The serial dispatcher serializes every batch's lifecycle — while batch
+k runs its compiled program, batch k+1's host-side pack (slab stacking
++ bucket-plan build + upload) waits in the queue, so the batch period
+is ``pack_s + device_s``.  The PR 9/10 ``pack`` spans price that tax
+directly; the reference line of GPU Louvain work (Naim et al.,
+arXiv:1805.10904) hides it by overlapping host preparation with device
+kernels.  This module brings the same overlap to the serving path
+(ISSUE 14):
+
+  * **packer** — pops one due batch under the intake lock
+    (``LouvainServer.pop_due``), then OUTSIDE the lock runs the PACK
+    stage (``pack_batch``: shape union, slab stacking, plan build,
+    device upload) and hands the PackedBatch over;
+  * **handoff** — a depth-1 blocking slot (:class:`Handoff`): the
+    packer blocks on ``put`` while the executor is still busy, giving
+    classic double buffering — at most one batch packed ahead;
+  * **executor** — takes each PackedBatch, runs the EXECUTE stage
+    (``execute_batch``: compiled program + retry + per-tenant
+    accounting), and delivers results/failures/sheds to the routing
+    callback.
+
+Steady-state batch period becomes ``max(pack_s, device_s)`` instead of
+their sum; ``ServeStats.overlap_frac`` measures the realized overlap.
+
+Drain ordering (the SIGTERM contract): once drain is requested the
+packer flushes every queued bin through pack and the handoff slot, then
+posts the close sentinel; the executor finishes the in-flight batch,
+drains the slot, sweeps the last terminal reports, and calls the
+``on_done`` callback (the daemon's summary emission).  A pack in flight
+when the drain arrives is handed off and executed exactly once — the
+``drain-vs-inflight-pack`` concheck scenario pins that interleaving.
+
+Every synchronization primitive comes from serve/sync.py (the seam),
+so concheck (graftlint tier 4) explores this exact two-thread machine
+under its deterministic scheduler; graftlint R022 keeps direct
+``threading.*`` construction out of serve/.  Fault-plan sites keep
+their stage homes: ``pack`` faults fire (and retry) on the packer
+thread, ``dispatch``/``device``/``unpack`` faults on the executor.
+Poison isolation runs in whichever stage hit the failure — the batch
+splits there and each job re-runs the full serial pack+execute alone.
+"""
+
+from __future__ import annotations
+
+from cuvite_tpu.serve import sync
+
+# Handoff close sentinel: posted by the packer after the final batch
+# (drain) so the executor can finish the slot and run the epilogue.
+_CLOSED = object()
+
+
+class Handoff:
+    """Depth-1 blocking handoff slot between the packer and the
+    executor (double buffering).  ``put`` blocks while the previous
+    item is still unconsumed; ``get`` blocks until an item (or the
+    close sentinel) arrives.  Built on the serve/sync.py Condition so
+    every handoff is a happens-before edge under the concheck
+    scheduler."""
+
+    def __init__(self, name: str = "handoff"):
+        self._cond = sync.Condition(name=name)
+        self._item = None
+        self._has = False
+        self._closed = False
+
+    def put(self, item) -> None:
+        with self._cond:
+            while self._has:
+                self._cond.wait()
+            self._item = item
+            self._has = True
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        """Post the end-of-stream marker (after the last ``put``)."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def get(self):
+        """The next item, or the ``CLOSED`` sentinel once the packer
+        closed an empty slot."""
+        with self._cond:
+            while not self._has:
+                if self._closed:
+                    return _CLOSED
+                self._cond.wait()
+            item = self._item
+            self._item = None
+            self._has = False
+            self._cond.notify_all()
+            return item
+
+    @property
+    def closed_sentinel(self):
+        return _CLOSED
+
+
+class PipelinedDispatcher:
+    """The two seam-threads around a LouvainServer (see module
+    docstring).  ``lock`` is the INTAKE lock — pops and submits
+    serialize under it (the daemon passes its own lock so the
+    drain-recheck invariant spans both); the pack and execute stages
+    run outside it.  ``route(finished, fails, sheds)`` delivers
+    per-job outcomes (the daemon's ``_route_results``); None collects
+    them on the dispatcher (``results``/``fails``/``sheds``) for
+    library callers like the load generator.  ``on_done`` runs on the
+    executor thread after the drain completes (the daemon's summary
+    emission) — ``wait_done`` unblocks after it."""
+
+    def __init__(self, server, *, lock=None, wake=None, drain_req=None,
+                 poll_s: float = 0.01, route=None, on_done=None):
+        self.server = server
+        self.lock = lock if lock is not None else sync.RLock(
+            name="PipelinedDispatcher.lock")
+        self._wake = wake if wake is not None else sync.Event(
+            name="PipelinedDispatcher._wake")
+        self._drain_req = drain_req if drain_req is not None else sync.Event(
+            name="PipelinedDispatcher._drain_req")
+        self._done = sync.Event(name="PipelinedDispatcher._done")
+        self.poll_s = poll_s
+        self.handoff = Handoff()
+        self._route = route
+        self._on_done = on_done
+        self.results: list = []
+        self.fails: list = []
+        self.sheds: list = []
+        self.pack_thread = None
+        self.exec_thread = None
+        with server.stats.lock:
+            server.stats.pipeline_depth = 2
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        self.pack_thread = sync.Thread(
+            target=self._pack_loop, name="serve-pack", daemon=True)
+        self.exec_thread = sync.Thread(
+            target=self._exec_loop, name="serve-execute", daemon=True)
+        self.pack_thread.start()
+        self.exec_thread.start()
+
+    def submit(self, graph, job_id=None, **kw) -> str:
+        """Intake for library callers (the daemon uses its own handle
+        path under the shared lock): enqueue under the intake lock and
+        wake the packer."""
+        with self.lock:
+            jid = self.server.submit(graph, job_id, **kw)
+        self._wake.set()
+        return jid
+
+    def wake(self) -> None:
+        self._wake.set()
+
+    def request_drain(self) -> None:
+        """Begin the drain (idempotent, signal-handler safe)."""
+        self._drain_req.set()
+        self._wake.set()
+
+    def wait_done(self, timeout: float | None = None) -> bool:
+        return self._done.wait(timeout)
+
+    # -- the two stages -----------------------------------------------------
+
+    def _pack_loop(self) -> None:
+        server = self.server
+        try:
+            while True:
+                self._wake.wait(timeout=self.poll_s)
+                self._wake.clear()
+                draining = self._drain_req.is_set()
+                while True:
+                    with self.lock:
+                        popped = server.pop_due(force=draining)
+                    if popped is None:
+                        break
+                    # The expensive stage, OUTSIDE the intake lock: a
+                    # slow pack must never stall submits or the stats
+                    # poll.  put() then blocks until the executor takes
+                    # the previous batch (depth-1 double buffering).
+                    packed = server.pack_batch(*popped)
+                    self.handoff.put(packed)
+                if draining:
+                    with self.lock:
+                        # Same-lock recheck as the serial loop: a submit
+                        # that saw drain_req unset enqueued under this
+                        # lock BEFORE this check, so its job is visible
+                        # here; one that sees it set is refused.
+                        if server.pending() == 0:
+                            break
+        finally:
+            self.handoff.close()
+
+    def _exec_loop(self) -> None:
+        server = self.server
+        while True:
+            item = self.handoff.get()
+            if item is _CLOSED:
+                break
+            finished = server.execute_batch(item)
+            self._deliver(finished)
+        # Final sweep: sheds/failures recorded by the packer after the
+        # executor's last delivery (e.g. a drain that shed everything).
+        self._deliver([])
+        if self._on_done is not None:
+            self._on_done()
+        self._done.set()
+
+    def _deliver(self, finished) -> None:
+        fails, sheds = self.server.consume_terminal()
+        if self._route is not None:
+            self._route(finished, fails, sheds)
+        else:
+            self.results.extend(finished)
+            self.fails.extend(fails)
+            self.sheds.extend(sheds)

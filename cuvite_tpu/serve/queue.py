@@ -42,9 +42,23 @@ enter the queue and are their own terminal state) —
 at all times; :meth:`LouvainServer.conservation` spells it out and the
 chaos tests assert it under randomized seeded fault plans.
 
+Dispatch is TWO stages since ISSUE 14 — ``pack_batch()`` (shape union,
+slab stacking + bucket-plan build + device upload, the 'pack' fault
+site) and ``execute_batch()`` (the compiled program + result routing,
+'dispatch'/'device'/'unpack' sites; a transient device retry re-runs
+the ALREADY-UPLOADED batch bit-identically) — composed serially by
+``step()``/``drain()``, or run on two seam-threads with a depth-1
+handoff slot by the pipelined dispatcher (serve/pipeline.py), which
+makes the steady-state batch period max(pack_s, device_s) instead of
+their sum.  Fields the two stages share (``_shapes``, ``_b_max``,
+``failures``, ``shed``, every ServeStats counter) live under the stats
+lock; bin mutation (``submit``/``pop_due``) serializes under the
+caller's intake lock (the daemon lock).
+
 This module deliberately contains NO jax calls: the compiled program
 lives at module scope in louvain/batched.py, device placement happens
-once per packed batch inside the driver.  graftlint R014 enforces the
+once per packed batch inside the driver (the pack stage calls
+louvain.batched.pack_many, the execute stage execute_many).  graftlint R014 enforces the
 corresponding trap (jit/vmap construction or per-job device_put inside
 a serve/ queue loop — the compile-per-job and upload-per-job mistakes
 that would silently erase the batching win), and R016 keeps every
@@ -78,6 +92,7 @@ from cuvite_tpu.serve.admission import (
     AdmissionConfig,
     AdmissionController,
     AdmissionReject,
+    BmaxAutotuner,
 )
 from cuvite_tpu.serve.faults import FaultPlan, InjectedFault
 
@@ -114,6 +129,13 @@ class ServeConfig:
     admission: AdmissionConfig | None = None
     max_retries: int = 3
     retry_base_s: float = 0.05
+    # Measured-service b_max autotuning (ISSUE 14): after a per-rung
+    # warm window, each class serves at the BATCH_SIZES rung that
+    # maximizes projected goodput under the admission SLO (see
+    # serve/admission.py::BmaxAutotuner); config b_max stays the cap.
+    # Requires `admission` (the SLO and the service estimator live
+    # there).
+    autotune_b_max: bool = False
 
     def __post_init__(self) -> None:
         # Config-time validation (ISSUE 11 satellite): a bad knob must
@@ -138,6 +160,11 @@ class ServeConfig:
             raise ValueError(
                 "admission must be an AdmissionConfig (or None to "
                 f"disable admission control), got {self.admission!r}")
+        if self.autotune_b_max and self.admission is None:
+            raise ValueError(
+                "autotune_b_max needs admission control: the tuner "
+                "reads the admission SLO and the measured per-class "
+                "service curve (serve/admission.py)")
         # Round up to a ladder rung (full bins then pack with zero
         # padding), capped at the ladder top — loudly: a silently
         # clamped b_max=1000 serving 64-row batches would mislead
@@ -159,6 +186,30 @@ class Job:
     tenant: str = "anon"
     # Absolute deadline on the server clock (None = never sheds).
     t_deadline: float | None = None
+
+
+@dataclasses.dataclass
+class PackedBatch:
+    """The handoff unit between the two dispatch stages (ISSUE 14): one
+    popped batch after the PACK stage — jobs, trigger provenance, the
+    sticky-union bucket geometry it packed against, and the uploaded
+    device-ready batch (``prep``, a louvain.batched.PreparedMany; None
+    on the injected-runner path, where execute runs the runner over the
+    raw graphs).  ``results`` non-None means the pack stage already
+    terminated every job (pack-site failure -> isolation) and
+    execute_batch passes them through."""
+
+    jobs: list
+    key: tuple
+    trigger: str
+    now: float               # pop-time clock (wait-measurement base)
+    n_real: int
+    b_pad: int
+    waits: list
+    shape: object = None     # geometry to record on success (bucketed)
+    prep: object = None      # PreparedMany (uploaded device buffers)
+    pack_s: float = 0.0      # pack-stage busy seconds (injectable clock)
+    results: list | None = None
 
 
 class _ClassBin:
@@ -255,6 +306,29 @@ class ServeStats:
     rows_padded: int = 0      # graftlint: guarded-by=self.lock — total batch rows incl. padding
     linger_dispatches: int = 0  # graftlint: guarded-by=self.lock
     busy_s: float = 0.0       # graftlint: guarded-by=self.lock — wall spent inside the batched driver
+    # Pipeline telemetry (ISSUE 14).  inflight: jobs popped from a bin
+    # but not yet terminal (packed / in the handoff slot / executing) —
+    # the conservation ledger's in-transit column.  pack_s/device_s:
+    # cumulative wall of the two dispatch stages on the injectable
+    # clock.  overlap_s: pack wall that ran CONCURRENTLY with a device
+    # execute window — overlap_frac = overlap_s / device_s is the
+    # pipelining win (0 under the serial dispatcher by construction).
+    inflight: int = 0         # graftlint: guarded-by=self.lock — popped, not yet terminal
+    pack_s: float = 0.0       # graftlint: guarded-by=self.lock — host pack + upload wall
+    device_s: float = 0.0     # graftlint: guarded-by=self.lock — execute-stage wall
+    overlap_s: float = 0.0    # graftlint: guarded-by=self.lock — pack wall inside execute windows
+    pipeline_depth: int = 1   # graftlint: guarded-by=self.lock — 2 under the pipelined dispatcher
+    # Overlap bookkeeping: the in-progress pack/execute window starts
+    # and the last completed execute window, on the injectable clock.
+    # exec_depth makes the execute window an ENVELOPE over concurrent
+    # windows (poison isolation can run a nested execute on the packer
+    # thread while the executor's own window is open — the envelope
+    # [first start, last end] is what "a device execute was in flight"
+    # means for the overlap integral).
+    pack_since: float | None = None   # graftlint: guarded-by=self.lock
+    exec_since: float | None = None   # graftlint: guarded-by=self.lock
+    exec_depth: int = 0               # graftlint: guarded-by=self.lock
+    last_exec: tuple | None = None    # graftlint: guarded-by=self.lock
     # enqueue->dispatch waits of the last WAIT_WINDOW jobs (seconds).
     wait_samples: collections.deque = dataclasses.field(  # graftlint: guarded-by=self.lock
         default_factory=lambda: collections.deque(maxlen=WAIT_WINDOW))
@@ -268,6 +342,57 @@ class ServeStats:
     def pack_util(self) -> float:
         with self.lock:
             return self.rows_real / max(self.rows_padded, 1)
+
+    @property
+    def overlap_frac(self) -> float:
+        """Fraction of device-execute wall during which a host pack was
+        concurrently in flight (the measured pipelining win)."""
+        with self.lock:
+            if self.device_s <= 0:
+                return 0.0
+            return min(self.overlap_s / self.device_s, 1.0)
+
+    # -- pipeline-stage windows (ISSUE 14) ----------------------------------
+    # The packer/executor stages report their attempt windows here; the
+    # overlap integral is accumulated on the PACK side only (each pack
+    # window is clipped against the running or last-completed execute
+    # window), so concurrent reporting never double-counts.  All on the
+    # server's injectable clock.
+
+    def pack_begins(self, t0: float) -> None:
+        with self.lock:
+            self.pack_since = t0
+
+    def pack_ends(self, t0: float, t1: float) -> None:
+        with self.lock:
+            self.pack_s += t1 - t0
+            self.pack_since = None
+            if self.exec_since is not None:
+                ov = t1 - max(t0, self.exec_since)
+            elif self.last_exec is not None:
+                s, e = self.last_exec
+                ov = min(t1, e) - max(t0, s)
+            else:
+                ov = 0.0
+            if ov > 0.0:
+                self.overlap_s += ov
+
+    def exec_begins(self, t0: float) -> None:
+        with self.lock:
+            self.exec_depth += 1
+            if self.exec_depth == 1:
+                self.exec_since = t0
+
+    def exec_ends(self, t0: float, t1: float) -> None:
+        with self.lock:
+            self.device_s += t1 - t0
+            self.exec_depth -= 1
+            if self.exec_depth <= 0:
+                self.exec_depth = 0
+                self.last_exec = (self.exec_since
+                                  if self.exec_since is not None else t0,
+                                  t1)
+                self.exec_since = None
 
     @property
     def jobs_per_s(self) -> float:
@@ -301,6 +426,11 @@ class ServeStats:
                 "linger_dispatches": self.linger_dispatches,
                 "busy_s": round(self.busy_s, 4),
                 "jobs_per_s": round(self.jobs_per_s, 2),
+                "inflight": self.inflight,
+                "pack_s": round(self.pack_s, 4),
+                "device_s": round(self.device_s, 4),
+                "overlap_frac": round(self.overlap_frac, 4),
+                "pipeline_depth": self.pipeline_depth,
             }
         out["wait_p50_ms"] = round(percentile(samples, 50.0) * 1e3, 3)
         out["wait_p95_ms"] = round(percentile(samples, 95.0) * 1e3, 3)
@@ -338,15 +468,23 @@ class LouvainServer:
         self.stats = ServeStats()
         self.admission = (AdmissionController(self.config.admission)
                           if self.config.admission is not None else None)
+        # Measured-service b_max autotuning (ISSUE 14): per-class
+        # effective rung in _b_max, retuned after each dispatch from
+        # the per-rung service curve; config.b_max stays the cap.
+        self.autotuner = (BmaxAutotuner(self.config.admission)
+                          if self.config.autotune_b_max else None)
         # Terminal reports for jobs that never produce a result: jobs
         # whose clustering raised -> (job_id, error string) in
         # ``failures`` (poison isolation, see _dispatch); jobs whose
         # deadline expired before dispatch -> (job_id, late_s) in
         # ``shed``.  The daemon consumes-and-CLEARS both per dispatch
-        # tick (a long-lived service must not grow them unboundedly);
-        # library callers read them after drain().
-        self.failures: list = []
-        self.shed: list = []
+        # tick via consume_terminal() (a long-lived service must not
+        # grow them unboundedly); library callers read them after
+        # drain().  Under the pipelined dispatcher the packer appends
+        # sheds while the executor appends failures, so both lists
+        # live under the stats lock.
+        self.failures: list = []   # graftlint: guarded-by=self.stats.lock
+        self.shed: list = []       # graftlint: guarded-by=self.stats.lock
         self._bins: dict = collections.defaultdict(_ClassBin)
         # Sticky per-slab-class bucket geometry (engine='bucketed'):
         # each dispatch pins the grow-only UNION of every geometry the
@@ -354,7 +492,10 @@ class LouvainServer:
         # degree-histogram jitter cannot churn compiled phase-0
         # programs — the compile count per class converges (bounded by
         # the class) instead of being one per distinct batch mix.
-        self._shapes: dict = {}
+        # Read by the packer stage, recorded by the executor stage
+        # (ISSUE 14) — hence the stats-lock discipline.
+        self._shapes: dict = {}    # graftlint: guarded-by=self.stats.lock
+        self._b_max: dict = {}     # graftlint: guarded-by=self.stats.lock
         self._ids = itertools.count()
 
     # -- intake -------------------------------------------------------------
@@ -387,8 +528,11 @@ class LouvainServer:
         now = self.clock() if t_submit is None else t_submit
         depth = self._bins[key].depth() if key in self._bins else 0
         if self.admission is not None:
-            retry_after = self.admission.decide(key, depth,
-                                                self.config.b_max)
+            # Under the stats lock: the executor stage observes service
+            # times concurrently with intake's projection (ISSUE 14).
+            with self.stats.lock:
+                retry_after = self.admission.decide(key, depth,
+                                                    self.b_max_for(key))
             if retry_after is not None:
                 with self.stats.lock:
                     self.stats.jobs_rejected += 1
@@ -426,6 +570,21 @@ class LouvainServer:
     def pending(self) -> int:
         return sum(b.depth() for b in self._bins.values())
 
+    def b_max_for(self, key) -> int:
+        """The class's EFFECTIVE batch cap: the autotuned rung when the
+        tuner has retuned it, else ``config.b_max`` (always <= the
+        config cap).  Locked: the executor stage retunes concurrently
+        with the packer's due-scan (stats.lock is an RLock, so callers
+        already holding it nest cleanly)."""
+        with self.stats.lock:
+            return self._b_max.get(key, self.config.b_max)
+
+    def autotuned(self) -> dict:
+        """{class key: rung} for every class the autotuner has moved
+        off the config default (empty without autotune_b_max)."""
+        with self.stats.lock:
+            return dict(self._b_max)
+
     def pin_shape(self, slab_class: tuple, shape) -> None:
         """Pre-pin a slab class's bucket geometry (engine='bucketed').
         Benches and the load generator pin the JOB-SET union
@@ -434,24 +593,40 @@ class LouvainServer:
         union then never grows past it."""
         from cuvite_tpu.core.batch import union_shapes
 
-        prev = self._shapes.get(slab_class)
-        self._shapes[slab_class] = (shape if prev is None
-                                    else union_shapes(prev, shape))
+        with self.stats.lock:
+            prev = self._shapes.get(slab_class)
+            self._shapes[slab_class] = (shape if prev is None
+                                        else union_shapes(prev, shape))
+
+    def consume_terminal(self) -> tuple:
+        """Atomically take (and clear) the no-result terminal reports —
+        ``(failures, shed)`` — for routing.  The daemon/dispatcher
+        calls this per delivery tick so a long-lived service never
+        grows the lists unboundedly."""
+        with self.stats.lock:
+            fails = list(self.failures)
+            self.failures.clear()
+            sheds = list(self.shed)
+            self.shed.clear()
+        return fails, sheds
 
     def conservation(self) -> dict:
         """Terminal accounting — the chaos invariant: every admitted
-        job is pending or terminated exactly once
-        (``done + failed + shed + pending == submitted``; rejected
-        jobs are their own terminal state and never enqueue)."""
+        job is pending, in flight (popped but not yet terminal — the
+        pipelined dispatcher's pack/handoff/execute transit), or
+        terminated exactly once (``done + failed + shed + pending +
+        inflight == submitted``; rejected jobs are their own terminal
+        state and never enqueue)."""
         with self.stats.lock:
             s = dict(submitted=self.stats.jobs_submitted,
                      done=self.stats.jobs_done,
                      failed=self.stats.jobs_failed,
                      shed=self.stats.jobs_shed,
-                     rejected=self.stats.jobs_rejected)
+                     rejected=self.stats.jobs_rejected,
+                     inflight=self.stats.inflight)
         s["pending"] = self.pending()
         s["ok"] = (s["done"] + s["failed"] + s["shed"] + s["pending"]
-                   == s["submitted"])
+                   + s["inflight"] == s["submitted"])
         return s
 
     # -- dispatch -----------------------------------------------------------
@@ -465,7 +640,7 @@ class LouvainServer:
             oldest = b.oldest_t_submit()
             if oldest is None:
                 continue
-            if force or b.depth() >= self.config.b_max \
+            if force or b.depth() >= self.b_max_for(key) \
                     or (now - oldest) >= self.config.linger_s:
                 due.append(key)
         return due
@@ -474,16 +649,19 @@ class LouvainServer:
         late = now - job.t_deadline
         with self.stats.lock:
             self.stats.jobs_shed += 1
-        self.shed.append((job.job_id, late))
+            self.shed.append((job.job_id, late))
         self.tracer.event("shed", job_id=job.job_id, tenant=job.tenant,
                           slab_class=list(job.slab_class),
                           late_s=round(late, 6))
 
-    def _pop_batch(self, b: _ClassBin, now: float) -> list:
-        """Round-robin pop up to ``b_max`` jobs, shedding expired ones
-        BEFORE they can occupy a batch row."""
+    def _pop_batch(self, b: _ClassBin, key, now: float) -> list:
+        """Round-robin pop up to the class's effective ``b_max`` jobs,
+        shedding expired ones BEFORE they can occupy a batch row.
+        Surviving jobs are counted in flight (conservation: popped but
+        not yet terminal)."""
         jobs = []
-        while len(jobs) < self.config.b_max:
+        b_max = self.b_max_for(key)
+        while len(jobs) < b_max:
             job = b.pop_rr()
             if job is None:
                 break
@@ -491,56 +669,85 @@ class LouvainServer:
                 self._shed_job(job, now)
                 continue
             jobs.append(job)
+        if jobs:
+            with self.stats.lock:
+                self.stats.inflight += len(jobs)
         return jobs
 
-    def _run_batch(self, jobs, b_pad, shape):
-        """The driver invocation, behind the 'device' fault site."""
-        self.faults.check("device")
-        runner = self._runner
-        if runner is None:
-            from cuvite_tpu.louvain.batched import cluster_many
+    def pop_due(self, now: float | None = None, force: bool = False):
+        """Pop ONE due batch — ``(jobs, key, trigger, now)``, or None
+        when nothing is due.  The packer stage's intake op: the caller
+        must hold the intake lock (the daemon lock) so pops serialize
+        against submits; the expensive pack then happens OUTSIDE it.
+        Popped jobs are in flight until :meth:`execute_batch` (or the
+        failure paths) terminate them."""
+        now = self.clock() if now is None else now
+        for key in self._due(now, force):
+            jobs = self._pop_batch(self._bins[key], key, now)
+            if not jobs:
+                continue  # the whole pop shed
+            # Label from the ACTUALLY-PACKED size: a bin that counted
+            # as full but shed down to a partial batch is a partial
+            # dispatch in the telemetry, not a 'full' one.
+            trigger = ("full" if len(jobs) >= self.b_max_for(key)
+                       else "drain" if force else "linger")
+            return jobs, key, trigger, now
+        return None
 
-            runner = cluster_many
-        return runner(
-            [j.graph for j in jobs],
-            threshold=self.config.threshold,
-            max_phases=self.config.max_phases,
-            b_pad=b_pad or None, mesh=self.config.mesh,
-            engine=self.config.engine, bucket_shape=shape,
-            tracer=self.tracer)
+    # -- the two dispatch stages (ISSUE 14) ---------------------------------
+    # pack_batch() — host-side batch assembly: shape union, slab
+    # stacking, bucket-plan build, device upload ('pack' fault site,
+    # with its own bounded transient retry).  execute_batch() — the
+    # compiled program + result routing ('dispatch'/'device'/'unpack'
+    # sites, retry re-runs the ALREADY-UPLOADED batch bit-identically).
+    # The serial path composes them in _dispatch(); the pipelined
+    # dispatcher (serve/pipeline.py) runs them on two seam-threads with
+    # a depth-1 handoff slot between, so the steady-state batch period
+    # is max(pack_s, device_s) instead of their sum.
 
-    def _fail_batch(self, jobs, key, sid, busy, waits, now, err) -> list:
-        """Permanent-failure path: close the pack span, then isolate —
-        a batch whose clustering RAISES must not take its batchmates
-        down: the batch splits and each job retries alone; a job that
-        fails alone lands in ``self.failures`` (never back in the
-        queue — a poison job re-queued would raise forever)."""
+    def _terminal_failure(self, job: Job, cls, wait, err) -> None:
+        """One job fails terminally: ledger + report + event."""
+        with self.stats.lock:
+            self.stats.jobs_failed += 1
+            # A failed job still waited in the queue; its sample
+            # belongs in the latency percentiles like any other.
+            self.stats.wait_samples.append(wait)
+            self.stats.inflight -= 1
+            self.failures.append((job.job_id, repr(err)))
+        self.tracer.event("tenant_error", job_id=job.job_id,
+                          tenant=job.tenant, slab_class=list(cls),
+                          error=repr(err))
+
+    def _fail_or_isolate(self, packed, sid, busy, err) -> list:
+        """Terminal path of either stage: close the stage span, then
+        isolate — a batch whose pack/clustering RAISES must not take
+        its batchmates down: the batch splits and each job retries
+        alone (a fresh pack+execute per job, in the thread that hit
+        the failure); a job that fails alone lands in ``self.failures``
+        (never back in the queue — a poison job re-queued would raise
+        forever)."""
+        jobs, key = packed.jobs, packed.key
         cls, _acc = key
         self.tracer.end_span(sid, wall_s=busy, error=repr(err))
         with self.stats.lock:
             self.stats.busy_s += busy
         if len(jobs) == 1:
-            job = jobs[0]
-            with self.stats.lock:
-                self.stats.jobs_failed += 1
-                # A failed job still waited in the queue; its sample
-                # belongs in the latency percentiles like any other.
-                self.stats.wait_samples.append(waits[0])
-            self.failures.append((job.job_id, repr(err)))
-            self.tracer.event("tenant_error", job_id=job.job_id,
-                              tenant=job.tenant, slab_class=list(cls),
-                              error=repr(err))
+            self._terminal_failure(jobs[0], cls, packed.waits[0], err)
             return []
         out = []
         for job in jobs:  # isolate the poison job, save the rest
-            out.extend(self._dispatch([job], key, "isolate", now))
+            out.extend(self._dispatch([job], key, "isolate", packed.now))
         return out
 
-    def _dispatch(self, jobs, key, trigger, now) -> list:
-        """Run one packed batch and unpack per-tenant results, with
-        bounded transient-fault retry around the attempt."""
+    def pack_batch(self, jobs, key, trigger, now) -> "PackedBatch":
+        """The PACK stage: bucket-geometry union, slab stacking + plan
+        build + device upload (louvain.batched.pack_many — ledger-
+        tracked, jax-free in THIS module), behind the 'pack' fault site
+        with bounded transient retry.  Returns a PackedBatch; on a
+        terminal pack failure its ``results`` carry the isolation
+        outcome and :meth:`execute_batch` passes them through."""
         cls, _acc = key
-        # Edgeless jobs are answered inline by cluster_many and occupy
+        # Edgeless jobs are answered inline by the driver and occupy
         # no batch row: the padded shape and the pack accounting follow
         # the rows that actually hit the device.
         n_real = sum(1 for j in jobs if j.graph.num_edges > 0)
@@ -549,6 +756,8 @@ class LouvainServer:
         # decision), on the injectable clock: per-batch percentiles ride
         # the pack span; the rolling aggregate feeds the serve summary.
         waits = [max(now - j.t_submit, 0.0) for j in jobs]
+        packed = PackedBatch(jobs=jobs, key=key, trigger=trigger, now=now,
+                             n_real=n_real, b_pad=b_pad, waits=waits)
         sid = self.tracer.begin_span(
             "pack", slab_class=list(cls), jobs=len(jobs), b_pad=b_pad,
             trigger=trigger, engine=self.config.engine,
@@ -563,9 +772,9 @@ class LouvainServer:
         attempt = 0
         while True:
             t0 = self.clock()
+            self.stats.pack_begins(t0)
             try:
                 self.faults.check("pack")
-                shape = None
                 if self.config.engine == "bucketed" and n_real:
                     from cuvite_tpu.core.batch import (
                         bucket_shape_for,
@@ -574,19 +783,99 @@ class LouvainServer:
 
                     need = bucket_shape_for(
                         [j.graph for j in jobs if j.graph.num_edges > 0])
-                    prev = self._shapes.get(cls)
-                    shape = need if prev is None else union_shapes(prev,
-                                                                   need)
+                    with self.stats.lock:
+                        prev = self._shapes.get(cls)
+                    packed.shape = (need if prev is None
+                                    else union_shapes(prev, need))
                     # The sticky union is recorded only AFTER the batch
-                    # completes (below): a poison job with an extreme
-                    # degree histogram must not inflate the class's
-                    # pinned geometry forever when it never produces a
-                    # result.
+                    # completes (execute_batch): a poison job with an
+                    # extreme degree histogram must not inflate the
+                    # class's pinned geometry forever when it never
+                    # produces a result.
+                if self._runner is None:
+                    from cuvite_tpu.louvain.batched import pack_many
+
+                    packed.prep = pack_many(
+                        [j.graph for j in jobs], b_pad=b_pad or None,
+                        mesh=self.config.mesh, engine=self.config.engine,
+                        bucket_shape=packed.shape, tracer=self.tracer)
+            except InjectedFault as e:
+                t1 = self.clock()
+                busy += t1 - t0
+                self.stats.pack_ends(t0, t1)
+                if not e.permanent and attempt < self.config.max_retries:
+                    attempt += 1
+                    backoff = self.config.retry_base_s * (2 ** (attempt - 1))
+                    with self.stats.lock:
+                        self.stats.retries += 1
+                    self.tracer.event(
+                        "retry", site=e.site, attempt=attempt,
+                        jobs=len(jobs), slab_class=list(cls),
+                        backoff_s=round(backoff, 6))
+                    self.sleep(backoff)
+                    continue
+                packed.results = self._fail_or_isolate(packed, sid, busy, e)
+                return packed
+            except Exception as e:  # noqa: BLE001 — isolation boundary
+                t1 = self.clock()
+                busy += t1 - t0
+                self.stats.pack_ends(t0, t1)
+                packed.results = self._fail_or_isolate(packed, sid, busy, e)
+                return packed
+            t1 = self.clock()
+            busy += t1 - t0
+            self.stats.pack_ends(t0, t1)
+            break
+        packed.pack_s = busy
+        self.tracer.end_span(sid, wall_s=busy, attempts=attempt + 1)
+        return packed
+
+    def _run_batch(self, packed: "PackedBatch"):
+        """The driver invocation, behind the 'device' fault site: the
+        prepared batch through execute_many, or the injected runner
+        (chaos tests) over the raw graphs."""
+        self.faults.check("device")
+        if self._runner is not None:
+            return self._runner(
+                [j.graph for j in packed.jobs],
+                threshold=self.config.threshold,
+                max_phases=self.config.max_phases,
+                b_pad=packed.b_pad or None, mesh=self.config.mesh,
+                engine=self.config.engine, bucket_shape=packed.shape,
+                tracer=self.tracer)
+        from cuvite_tpu.louvain.batched import execute_many
+
+        return execute_many(
+            packed.prep, threshold=self.config.threshold,
+            max_phases=self.config.max_phases, tracer=self.tracer)
+
+    def execute_batch(self, packed: "PackedBatch") -> list:
+        """The EXECUTE stage: run the prepared batch's compiled program
+        and unpack per-tenant results, with bounded transient-fault
+        retry ('dispatch'/'device'/'unpack' sites).  A retry re-runs
+        the SAME uploaded batch — execute_prepared restarts from the
+        phase-0 device state, bit-identically, with no re-pack."""
+        if packed.results is not None:
+            return packed.results       # pack stage already terminal
+        jobs, key = packed.jobs, packed.key
+        cls, _acc = key
+        sid = self.tracer.begin_span(
+            "execute", slab_class=list(cls), jobs=len(jobs),
+            b_pad=packed.b_pad, trigger=packed.trigger,
+            engine=self.config.engine)
+        busy = 0.0
+        attempt = 0
+        while True:
+            t0 = self.clock()
+            self.stats.exec_begins(t0)
+            try:
                 self.faults.check("dispatch")
-                br = self._run_batch(jobs, b_pad, shape)
+                br = self._run_batch(packed)
                 self.faults.check("unpack")
             except InjectedFault as e:
-                busy += self.clock() - t0
+                t1 = self.clock()
+                busy += t1 - t0
+                self.stats.exec_ends(t0, t1)
                 if not e.permanent and attempt < self.config.max_retries:
                     attempt += 1
                     backoff = self.config.retry_base_s * (2 ** (attempt - 1))
@@ -599,32 +888,55 @@ class LouvainServer:
                     self.sleep(backoff)
                     continue
                 # Permanent, or transient past the retry budget: the
-                # existing poison machinery is the terminal path.
-                return self._fail_batch(jobs, key, sid, busy, waits, now, e)
+                # existing poison machinery is the terminal path.  The
+                # batch's pack busy is charged too — the pre-split
+                # dispatcher accumulated the whole dispatch's busy on
+                # failure, and busy_s must not depend on WHICH stage
+                # raised.
+                return self._fail_or_isolate(packed, sid,
+                                             packed.pack_s + busy, e)
             except Exception as e:  # noqa: BLE001 — isolation boundary
-                busy += self.clock() - t0
-                return self._fail_batch(jobs, key, sid, busy, waits, now, e)
-            busy += self.clock() - t0
+                t1 = self.clock()
+                busy += t1 - t0
+                self.stats.exec_ends(t0, t1)
+                return self._fail_or_isolate(packed, sid,
+                                             packed.pack_s + busy, e)
+            t1 = self.clock()
+            busy += t1 - t0
+            self.stats.exec_ends(t0, t1)
             break
         self.tracer.end_span(sid, wall_s=busy, phases=br.n_phases,
                              attempts=attempt + 1)
-        if shape is not None:
-            self._shapes[cls] = shape
+        service_s = packed.pack_s + busy
         with self.stats.lock:
-            if n_real:
+            if packed.shape is not None:
+                # UNION with the current sticky state, not an overwrite:
+                # under the pipelined dispatcher batch k+1 packs (and
+                # reads _shapes) before batch k's execute records, so a
+                # plain assignment could drop k's geometry and shrink
+                # the grow-only union (churning compiled programs).
+                from cuvite_tpu.core.batch import union_shapes
+
+                prev = self._shapes.get(cls)
+                self._shapes[cls] = (packed.shape if prev is None
+                                     else union_shapes(prev, packed.shape))
+            if packed.n_real:
                 self.stats.batches += 1
-                self.stats.rows_real += n_real
-                self.stats.rows_padded += b_pad
-            self.stats.busy_s += busy
-            if trigger == "linger":
+                self.stats.rows_real += packed.n_real
+                self.stats.rows_padded += packed.b_pad
+            self.stats.busy_s += service_s
+            if packed.trigger == "linger":
                 self.stats.linger_dispatches += 1
-        if self.admission is not None and n_real:
-            self.admission.observe(key, busy)
+            if self.admission is not None and packed.n_real:
+                self.admission.observe(key, service_s)
+        self._maybe_retune(key, packed.b_pad, service_s,
+                           n_real=packed.n_real)
         out = []
-        for job, res, wait in zip(jobs, br.results, waits):
+        for job, res, wait in zip(jobs, br.results, packed.waits):
             with self.stats.lock:
                 self.stats.jobs_done += 1
                 self.stats.wait_samples.append(wait)
+                self.stats.inflight -= 1
             self.tracer.event(
                 "tenant_result", job_id=job.job_id, tenant=job.tenant,
                 slab_class=list(cls), q=float(res.modularity),
@@ -635,6 +947,34 @@ class LouvainServer:
             out.append((job.job_id, res))
         return out
 
+    def _maybe_retune(self, key, b_pad: int, service_s: float, *,
+                      n_real: int) -> None:
+        """Feed the autotuner one (rung, service) sample and apply its
+        pick; an ``autotune`` event fires on EVERY effective-b_max
+        change (the operator-visible record of the retune)."""
+        if self.autotuner is None or not n_real:
+            return
+        with self.stats.lock:
+            self.autotuner.observe(key, b_pad, service_s)
+            new = self.autotuner.pick(key, self.config.b_max)
+            cur = self._b_max.get(key, self.config.b_max)
+            if new is None or new == cur:
+                return
+            self._b_max[key] = new
+            curve = self.autotuner.curve(key)
+        self.tracer.event(
+            "autotune", slab_class=list(key[0]), b_max_old=cur,
+            b_max_new=new,
+            curve={str(r): round(est, 6)
+                   for r, est in sorted(curve.items())})
+
+    def _dispatch(self, jobs, key, trigger, now) -> list:
+        """The SERIAL dispatch: pack then execute on the calling thread
+        (step()/drain() and the per-job isolation splitter).  The
+        pipelined dispatcher runs the same two halves on separate
+        threads."""
+        return self.execute_batch(self.pack_batch(jobs, key, trigger, now))
+
     def step(self, now: float | None = None, force: bool = False) -> list:
         """Run every due batch; returns [(job_id, LouvainResult), ...]
         in pop order per batch.  One call may run several batches (one
@@ -644,14 +984,13 @@ class LouvainServer:
         now = self.clock() if now is None else now
         out = []
         for key in self._due(now, force):
-            b = self._bins[key]
-            jobs = self._pop_batch(b, now)
+            jobs = self._pop_batch(self._bins[key], key, now)
             if not jobs:
                 continue  # the whole pop shed
             # Label from the ACTUALLY-PACKED size: a bin that counted
             # as full but shed down to a partial batch is a partial
             # dispatch in the telemetry, not a 'full' one.
-            trigger = ("full" if len(jobs) >= self.config.b_max
+            trigger = ("full" if len(jobs) >= self.b_max_for(key)
                        else "drain" if force else "linger")
             out.extend(self._dispatch(jobs, key, trigger, now))
         return out

@@ -209,10 +209,16 @@ def _validate_batch_block(batch) -> list:
 # overload acceptance run); reject_rate / shed_rate — the fraction of
 # offered jobs terminally rejected (admission) or shed (deadline).
 # perf_regress gates goodput like-for-like (same b_max, admission,
-# SLO, job shape, engine).
+# SLO, job shape, engine, pipeline mode).  `pipelined` (ISSUE 14) is
+# REQUIRED: a serve record must say which dispatcher architecture ran —
+# the pipelined goodput sits well above the serial one by design, so an
+# untagged record would poison whichever trajectory it landed in.
+# `autotuned_b_max` is optional: the rung the measured-service
+# autotuner settled on, when autotuning moved the class off the config
+# default.
 REQUIRED_SERVE_KEYS = ("b_max", "arrival_jobs_per_s", "goodput_jobs_per_s",
                        "wait_p95_ms", "slo_ms", "admission", "reject_rate",
-                       "shed_rate")
+                       "shed_rate", "pipelined")
 
 
 def _validate_serve_block(serve) -> list:
@@ -224,6 +230,14 @@ def _validate_serve_block(serve) -> list:
                 for k in REQUIRED_SERVE_KEYS if k not in serve]
     if problems:
         return problems
+    if not isinstance(serve["pipelined"], bool):
+        problems.append(
+            f"serve.pipelined must be a bool, got {serve['pipelined']!r}")
+    ab = serve.get("autotuned_b_max")
+    if ab is not None and (not isinstance(ab, int) or ab < 1):
+        problems.append(
+            f"serve.autotuned_b_max must be a positive int rung, "
+            f"got {ab!r}")
     if not isinstance(serve["b_max"], int) or serve["b_max"] < 1:
         problems.append(
             f"serve.b_max must be a positive int, got {serve['b_max']!r}")
@@ -680,6 +694,8 @@ def run_serve_bench(
     engine: str = "bucketed",
     platform: str = "cpu",
     budget_s: float = 420.0,
+    pipelined: bool = False,
+    autotune: bool = False,
     t_start: float | None = None,
 ) -> dict:
     """Open-loop serving bench (ISSUE 11): offer ``n_jobs``
@@ -689,6 +705,14 @@ def run_serve_bench(
     offered rate, queue-wait p95 vs the SLO, reject/shed outcome
     rates).  ``admission=False`` is the overload A/B arm: same rate,
     no intake bound — the run that shows unbounded queue-wait growth.
+
+    ``pipelined`` (ISSUE 14) drives the two-stage dispatcher (packer
+    overlaps executor; serve/pipeline.py) instead of the serial
+    in-loop ``step()``; the record's ``serve.pipelined`` keeps the two
+    architectures' goodput trajectories apart in perf_regress.
+    ``autotune`` enables measured-service b_max autotuning (needs
+    admission); the rung the tuner settles on lands in
+    ``serve.autotuned_b_max``.
 
     Compile discipline: the warm-up runs ONE batch at every
     BATCH_SIZES rung <= ``b_max`` with the job-set-pinned bucket
@@ -737,10 +761,14 @@ def run_serve_bench(
             f"serve bench warm-up alone spent {elapsed:.0f}s of the "
             f"{budget_s:.0f}s budget; shrink --serve-b-max/--batch-edges")
 
+    if autotune and not admission:
+        raise ValueError("--serve-autotune needs admission on (the "
+                         "tuner reads the admission SLO + estimator)")
     config = ServeConfig(
         b_max=b_max, linger_s=linger_ms / 1e3, engine=engine,
         admission=(AdmissionConfig(wait_slo_s=slo_ms / 1e3)
-                   if admission else None))
+                   if admission else None),
+        autotune_b_max=bool(autotune))
     tr = Tracer(recorder=frec)
     server = LouvainServer(config, tracer=tr)
     if shape is not None:
@@ -750,7 +778,8 @@ def run_serve_bench(
             server, graphs, rate, tenants=tenants,
             deadline_s=(deadline_ms / 1e3 if deadline_ms is not None
                         else None),
-            max_wall_s=max(budget_s - elapsed, 30.0))
+            max_wall_s=max(budget_s - elapsed, 30.0),
+            pipelined=pipelined)
     if watch.compiles:
         raise BenchCompileGuardError(watch.compiles)
     if not rep.results:
@@ -762,6 +791,7 @@ def run_serve_bench(
             f"job-conservation violation: {rep.conservation}")
 
     results = [r for _, r in rep.results]
+    stats_snap = server.stats.to_dict()   # one atomic snapshot
     traversed = sum(p.num_edges * p.iterations
                     for r in results for p in r.phases)
     teps = traversed / max(rep.wall_s, 1e-9)
@@ -792,6 +822,12 @@ def run_serve_bench(
         "serve": {
             "b_max": int(b_max),
             "engine": engine,
+            "pipelined": bool(pipelined),
+            **({"autotuned_b_max": int(next(iter(tuned.values())))}
+               if (tuned := server.autotuned()) else {}),
+            "overlap_frac": stats_snap["overlap_frac"],
+            "pack_s": stats_snap["pack_s"],
+            "device_s": stats_snap["device_s"],
             "arrival_jobs_per_s": round(rate, 3),
             "goodput_jobs_per_s": round(rep.goodput_jobs_per_s, 3),
             "wait_p50_ms": round(rep.wait_p50_s * 1e3, 3),
@@ -883,6 +919,15 @@ def _build_parser() -> argparse.ArgumentParser:
                         "(exercises shedding)")
     s.add_argument("--serve-tenants", type=int, default=1,
                    help="spread jobs round-robin over N tenant ids")
+    s.add_argument("--serve-pipeline", default="off", choices=["on", "off"],
+                   help="'on' drives the two-stage pipelined dispatcher "
+                        "(ISSUE 14: host pack overlaps device execute); "
+                        "the record's serve.pipelined keeps the "
+                        "trajectories apart in perf_regress")
+    s.add_argument("--serve-autotune", action="store_true",
+                   help="measured-service b_max autotuning (needs "
+                        "admission on); the settled rung lands in "
+                        "serve.autotuned_b_max")
     return p
 
 
@@ -916,6 +961,8 @@ def main(argv=None) -> int:
                 tenants=args.serve_tenants,
                 engine=args.batch_engine, platform=platform,
                 budget_s=args.budget,
+                pipelined=args.serve_pipeline == "on",
+                autotune=args.serve_autotune,
             )
         except BenchCompileGuardError as e:
             print(f"# BENCH ABORTED: {e}", file=sys.stderr)

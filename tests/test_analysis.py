@@ -498,6 +498,48 @@ def injected_default(clock=time.monotonic):
         "cuvite_tpu/serve/fake_r016.py",
     ),
     (
+        "R022",
+        """
+import threading
+from threading import Event, Thread
+
+
+def start(daemon):
+    # direct construction EXITS the sync seam: invisible to every
+    # concheck tier-4 schedule
+    daemon.lock = threading.Lock()
+    daemon.wake = Event()
+    t = Thread(target=daemon.run)
+    t.start()
+    return t
+""",
+        """
+import threading
+
+from cuvite_tpu.serve import sync
+
+
+def start(daemon):
+    # the seam factories: plain threading in production,
+    # scheduler-backed twins under concheck
+    daemon.lock = sync.Lock()
+    daemon.wake = sync.Event()
+    t = sync.Thread(target=daemon.run, name="d")
+    t.start()
+    return t
+
+
+def annotate(x: threading.RLock) -> None:
+    # a bare TYPE reference is not a construction
+    pass
+
+
+def justified():
+    return threading.Barrier(2)  # graftlint: disable=R022 — test-harness barrier, never under the scheduler
+""",
+        "cuvite_tpu/serve/fake_r022.py",
+    ),
+    (
         "R019",
         """
 import threading

@@ -182,6 +182,87 @@ def test_drain_races_retry_backoff_terminates_exactly_once():
 
 
 # ---------------------------------------------------------------------------
+# The pipelined dispatcher (ISSUE 14): packer/executor/intake/drainer
+
+
+def test_pipeline_clean_across_200_interleavings():
+    """The ISSUE-14 acceptance gate: the PIPELINED daemon — packer +
+    executor seam-threads, intake, poller, drainer — explores clean
+    (0 races / deadlocks / assertion failures) and job conservation +
+    wire-level exactly-once hold across >= 200 DISTINCT interleavings
+    (asserted per schedule by DaemonScenario.check)."""
+    budget = max(concheck.schedule_budget(), 200)
+    rep = concheck.explore(scenario("pipeline-clean"), budget=budget,
+                           seed=17)
+    assert rep.clean, (rep.failures()[:3], rep.races()[:3])
+    assert rep.schedules == budget
+    assert rep.distinct >= 200, \
+        f"only {rep.distinct} distinct interleavings explored"
+    assert not rep.warnings, rep.warnings
+
+
+def test_pipeline_faulty_explores_clean():
+    """Transient pack + device faults through the pipelined dispatcher:
+    retries fire in their home stages (pack on the packer, device on
+    the executor) and every schedule still conserves + delivers
+    exactly once."""
+    rep = concheck.explore(scenario("pipeline-faulty"), budget=24,
+                           seed=23)
+    assert rep.clean, (rep.failures()[:3], rep.races()[:3])
+
+
+def test_drain_vs_inflight_pack_flushes_handoff_exactly_once():
+    """A drain requested MID-PACK (the packer parked at its in-pack
+    schedule point) must flush the in-flight PackedBatch through the
+    handoff slot exactly once, then the bins — asserted per schedule
+    by the exactly-once check.  The trace scan proves >= 1 schedule
+    actually interleaved the drain request inside the pack window
+    (between the packer's in-pack sleep and its handoff acquisition),
+    so the scenario targets what it claims to."""
+    scen = scenario("drain-vs-inflight-pack")
+    drain_mid_pack = 0
+    for i in range(24):
+        rep = concheck.run_schedule(scen, seed=700 + i,
+                                    strategy=("random", "pct")[i % 2])
+        assert rep.clean, (rep.seed, rep.failures, rep.races)
+        pack_sleep = None
+        handoff_after = None
+        drain_set = None
+        for step, (tname, op, detail) in enumerate(rep.trace):
+            if tname == "packer" and op == "sleep" and pack_sleep is None:
+                pack_sleep = step
+            if tname == "packer" and op == "acquire" \
+                    and detail == "Handoff.lock" and pack_sleep is not None \
+                    and handoff_after is None:
+                handoff_after = step
+            if tname == "drainer" and op == "set" \
+                    and "drain_req" in detail:
+                drain_set = step
+        if pack_sleep is not None and handoff_after is not None \
+                and drain_set is not None \
+                and pack_sleep < drain_set < handoff_after:
+            drain_mid_pack += 1
+    assert drain_mid_pack >= 1, \
+        "no schedule interleaved the drain request inside an " \
+        "in-flight pack — the scenario lost its targeting"
+
+
+def test_routes_race_still_convicted_with_pipeline_scenarios_present():
+    """The resurrected PR-12 fixtures keep convicting after the
+    scenario registry grew the pipeline entries (a checker that stops
+    seeing known bugs is broken)."""
+    names = set(concheck.builtin_scenarios())
+    assert {"pipeline-clean", "pipeline-faulty",
+            "drain-vs-inflight-pack"} <= names
+    rep = concheck.explore(scenario("racy-routes"), budget=32, seed=1,
+                           stop_on_failure=True)
+    assert not rep.clean
+    rep = concheck.explore(scenario("send-under-lock"), budget=16,
+                           seed=1, stop_on_failure=True)
+    assert not rep.clean
+
+
+# ---------------------------------------------------------------------------
 # Vector-clock semantics (unit level)
 
 

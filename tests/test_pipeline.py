@@ -1,0 +1,495 @@
+"""Pipelined-dispatch tests (ISSUE 14): the pack/execute split, the
+two-seam-thread dispatcher, measured-service b_max autotuning, the
+overlap telemetry, the serve-record pipeline tagging, and the chaos
+gate through the pipelined dispatcher.
+
+Real-thread tests use the stub runner (instant, pure function of the
+graph) so hundreds of jobs cost milliseconds; the real-jax tests pin
+the one property the stub cannot — per-tenant labels/Q bit-identical
+across serial dispatch, pipelined dispatch, and B=1.
+"""
+
+import json
+import subprocess
+import sys
+import time
+import types
+
+import numpy as np
+import pytest
+
+from cuvite_tpu.core.graph import Graph
+from cuvite_tpu.serve import (
+    AdmissionConfig,
+    BmaxAutotuner,
+    FaultPlan,
+    InjectedFault,
+    LouvainServer,
+    PipelinedDispatcher,
+    ServeConfig,
+)
+from cuvite_tpu.serve.loadgen import run_open_loop
+from cuvite_tpu.workloads.bench import validate_record
+from cuvite_tpu.workloads.synth import many_seed, synthesize_graph
+
+from tests.test_serve import REPO, PERF_REGRESS  # noqa: F401
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, s: float) -> None:
+        self.t += s
+
+
+def make_graph(seed: int, nv: int = 16, ne: int = 32) -> Graph:
+    rng = np.random.default_rng(seed)
+    return Graph.from_edges(nv, rng.integers(0, nv, ne),
+                            rng.integers(0, nv, ne))
+
+
+def stub_result(g):
+    nv = g.num_vertices
+    key = int(np.sum(g.tails)) % 997
+    return types.SimpleNamespace(
+        communities=(np.arange(nv) + key) % max(nv, 1),
+        modularity=key / 997.0,
+        phases=[1], total_iterations=3, num_communities=nv)
+
+
+def make_stub_runner(clock=None, service_of=None):
+    """cluster_many-shaped stub; ``service_of(n_graphs)`` consumes that
+    much virtual time per batch (the rung-dependent service curve the
+    autotune tests drive)."""
+
+    def runner(graphs, **kw):
+        if clock is not None and service_of is not None:
+            clock.sleep(service_of(len(graphs)))
+        return types.SimpleNamespace(
+            results=[stub_result(g) for g in graphs], n_phases=1)
+
+    return runner
+
+
+# ---------------------------------------------------------------------------
+# BmaxAutotuner (unit)
+
+
+KEY = ((4096, 16384), "float32")
+
+
+def test_autotuner_picks_goodput_optimal_feasible_rung():
+    """THE acceptance curve: the default b_max=64 rung is
+    SLO-infeasible (batch service >> SLO), a smaller measured rung
+    wins on projected goodput."""
+    at = BmaxAutotuner(AdmissionConfig(wait_slo_s=0.5, headroom=1.0))
+    for _ in range(3):
+        at.observe(KEY, 64, 10.0)    # infeasible: 10 s >> 0.5 s SLO
+        at.observe(KEY, 8, 0.2)      # feasible, goodput 40 jobs/s
+        at.observe(KEY, 16, 0.45)    # feasible, goodput 35.6 jobs/s
+    assert at.pick(KEY, 64) == 8
+    # The cap clamps the candidate set (a rung above it never wins).
+    assert at.pick(KEY, 8) == 8
+
+
+def test_autotuner_never_picks_an_unmeasured_rung():
+    """The compile clamp: a rung below its warm window (== a rung whose
+    program may not be compiled) is not a candidate, however good its
+    projected goodput would be."""
+    at = BmaxAutotuner(AdmissionConfig(wait_slo_s=0.5))
+    at.observe(KEY, 8, 0.01)
+    at.observe(KEY, 8, 0.01)         # 2 obs < min_obs=3: not warm
+    assert at.pick(KEY, 64) is None
+    at.observe(KEY, 8, 0.01)         # warm now
+    at.observe(KEY, 64, 0.001)       # 1 obs: tempting but NOT warm
+    assert at.pick(KEY, 64) == 8
+    assert 64 not in at.curve(KEY)
+
+
+def test_autotuner_infeasible_curve_falls_back_to_fastest():
+    at = BmaxAutotuner(AdmissionConfig(wait_slo_s=0.01, headroom=1.0))
+    for _ in range(3):
+        at.observe(KEY, 8, 0.8)
+        at.observe(KEY, 2, 0.3)      # nothing feasible: least-bad wins
+    assert at.pick(KEY, 64) == 2
+
+
+def test_autotune_config_validates():
+    from cuvite_tpu.serve import AutotuneConfig
+
+    with pytest.raises(ValueError, match="min_obs"):
+        AutotuneConfig(min_obs=0)
+    with pytest.raises(ValueError, match="window"):
+        AutotuneConfig(min_obs=8, window=4)
+    with pytest.raises(ValueError, match="autotune_b_max"):
+        ServeConfig(autotune_b_max=True)   # needs admission
+
+
+# ---------------------------------------------------------------------------
+# Server-level autotune (fake clock, rung-dependent service curve)
+
+
+def test_server_autotunes_b_max_and_emits_event():
+    """Affine service 0.1 + 0.05*n: rung 8 breaches the 0.5 s SLO
+    (0.5 * 1.25 headroom > 0.5), rung 4 is the goodput-optimal
+    feasible rung — after the warm window the class serves at 4 and an
+    ``autotune`` event records the change."""
+    from cuvite_tpu.obs import FlightRecorder, MemoryTraceSink
+    from cuvite_tpu.utils.trace import Tracer
+
+    clock = FakeClock()
+    sink = MemoryTraceSink()
+    srv = LouvainServer(
+        ServeConfig(b_max=8, linger_s=0.0, engine="fused",
+                    admission=AdmissionConfig(wait_slo_s=0.5),
+                    autotune_b_max=True),
+        clock=clock, sleep=clock.sleep,
+        tracer=Tracer(recorder=FlightRecorder(sink, watch_compiles=False)),
+        runner=make_stub_runner(clock, lambda n: 0.1 + 0.05 * n))
+    key = None
+    # Warm rungs 8, 4, 2 (3 dispatches each — exact-size batches).
+    for rung in (8, 8, 8, 4, 4, 4, 2, 2, 2):
+        for s in range(rung):
+            srv.submit(make_graph(1000 + s))
+        done = srv.step(force=True)
+        assert len(done) == rung
+    key = next(iter(srv.autotuned()), None)
+    assert key is not None, "autotune never moved the class"
+    assert srv.autotuned()[key] == 4
+    assert srv.b_max_for(key) == 4
+    events = [r for r in sink.records
+              if r.get("t") == "event" and r.get("name") == "autotune"]
+    assert events, "no autotune event emitted"
+    assert events[-1]["attrs"]["b_max_new"] == 4
+    assert "curve" in events[-1]["attrs"]
+    # The retuned rung now caps dispatch: 8 queued jobs pop as 4+4.
+    for s in range(8):
+        srv.submit(make_graph(2000 + s))
+    srv.drain()
+    assert srv.conservation()["ok"]
+    with srv.stats.lock:
+        assert srv.stats.inflight == 0
+
+
+# ---------------------------------------------------------------------------
+# Pack/execute split telemetry (serial path)
+
+
+def test_pack_and_execute_spans_split(tmp_path):
+    from cuvite_tpu.obs import FlightRecorder, MemoryTraceSink, spans_of
+    from cuvite_tpu.utils.trace import Tracer
+
+    clock = FakeClock()
+    sink = MemoryTraceSink()
+    srv = LouvainServer(
+        ServeConfig(b_max=2, linger_s=0.0, engine="fused"),
+        clock=clock, tracer=Tracer(
+            recorder=FlightRecorder(sink, watch_compiles=False)),
+        runner=make_stub_runner(clock, lambda n: 0.1))
+    srv.submit(make_graph(1))
+    srv.submit(make_graph(2))
+    srv.step()
+    packs = spans_of(sink.records, "pack")
+    execs = spans_of(sink.records, "execute")
+    assert len(packs) == 1 and len(execs) == 1
+    assert packs[0]["begin"]["attrs"]["trigger"] == "full"
+    assert "wall_s" in packs[0]["end"]["attrs"]
+    assert execs[0]["begin"]["attrs"]["b_pad"] == 2
+    assert execs[0]["end"]["attrs"]["phases"] == 1
+    # The stub consumed 0.1 s inside execute on the injectable clock.
+    st = srv.stats.to_dict()
+    assert st["device_s"] == pytest.approx(0.1)
+    assert st["pipeline_depth"] == 1 and st["overlap_frac"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# The pipelined dispatcher: bit-identity + overlap (real jax)
+
+
+@pytest.fixture(scope="module")
+def pipe_graphs():
+    return [synthesize_graph(512, seed=many_seed(31, k)) for k in range(8)]
+
+
+@pytest.fixture(scope="module")
+def pipe_vs_serial(pipe_graphs):
+    srv_p = LouvainServer(ServeConfig(b_max=4, linger_s=0.02))
+    rep_p = run_open_loop(srv_p, pipe_graphs, rate=500.0, pipelined=True)
+    srv_s = LouvainServer(ServeConfig(b_max=4, linger_s=0.02))
+    rep_s = run_open_loop(srv_s, pipe_graphs, rate=500.0)
+    return srv_p, rep_p, srv_s, rep_s
+
+
+def test_pipelined_results_bit_identical_to_serial_and_b1(
+        pipe_graphs, pipe_vs_serial):
+    from cuvite_tpu.louvain.batched import cluster_many
+
+    _srv_p, rep_p, _srv_s, rep_s = pipe_vs_serial
+    assert rep_p.conservation["ok"] and rep_s.conservation["ok"]
+    assert rep_p.done == rep_s.done == len(pipe_graphs)
+    dp, ds = dict(rep_p.results), dict(rep_s.results)
+    assert set(dp) == set(ds)
+    for k in dp:
+        assert dp[k].modularity == ds[k].modularity
+        assert np.array_equal(dp[k].communities, ds[k].communities)
+    # ... and to B=1 solo runs through the same batched driver.
+    by_submit = [jid for jid, _ in sorted(
+        dp.items(), key=lambda kv: int(kv[0].split("-")[1]))]
+    for jid, g in zip(by_submit, pipe_graphs):
+        solo = cluster_many([g], engine="bucketed").results[0]
+        assert dp[jid].modularity == solo.modularity
+        assert np.array_equal(dp[jid].communities, solo.communities)
+
+
+def test_pipelined_overlap_telemetry(pipe_vs_serial):
+    srv_p, _rep_p, srv_s, _rep_s = pipe_vs_serial
+    stp = srv_p.stats.to_dict()
+    sts = srv_s.stats.to_dict()
+    assert stp["pipeline_depth"] == 2 and sts["pipeline_depth"] == 1
+    assert stp["pack_s"] > 0 and stp["device_s"] > 0
+    assert 0.0 <= stp["overlap_frac"] <= 1.0
+    # The serial dispatcher can never overlap by construction.
+    assert sts["overlap_frac"] == 0.0
+    assert stp["inflight"] == 0 and sts["inflight"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Chaos gate through the PIPELINED dispatcher (acceptance): seeded fault
+# plan at all five sites over >= 240 jobs — conservation holds,
+# survivors bit-identical to fault-free.
+
+CHAOS_PLAN = (
+    "submit:transient:p=0.02,seed=11;"
+    "pack:transient:p=0.05,seed=12;"
+    "dispatch:raise:p=0.03,seed=13;"
+    "device:transient:p=0.08,seed=14;"
+    "device:raise:p=0.02,seed=15;"
+    "unpack:transient:p=0.04,seed=16"
+)
+
+
+def test_pipelined_chaos_conservation_and_identity():
+    n_jobs = 240
+    faults = FaultPlan.parse(CHAOS_PLAN)
+    srv = LouvainServer(
+        ServeConfig(b_max=8, linger_s=0.002, engine="fused",
+                    max_retries=2, retry_base_s=0.001),
+        faults=faults, runner=make_stub_runner())
+    pipe = PipelinedDispatcher(srv, poll_s=0.002)
+    pipe.start()
+    outcomes = {}
+    graphs = {}
+    for k in range(n_jobs):
+        jid = f"j{k}"
+        g = make_graph(k)
+        graphs[jid] = g
+        # Every 11th job arrives already expired: the deterministic
+        # shed path (real clock: a future deadline would usually be
+        # met by the instant stub).
+        deadline = -0.001 if k % 11 == 0 else None
+        try:
+            pipe.submit(g, job_id=jid, tenant=f"t{k % 7}",
+                        deadline_s=deadline)
+        except InjectedFault:
+            outcomes[jid] = "rejected"
+        if k % 40 == 39:
+            time.sleep(0.005)        # bursty arrivals
+    pipe.request_drain()
+    assert pipe.wait_done(timeout=120.0), "pipelined drain wedged"
+    for jid, res in pipe.results:
+        assert jid not in outcomes, f"{jid} terminated twice"
+        outcomes[jid] = ("done", res)
+    for jid, _err in pipe.fails:
+        assert jid not in outcomes, f"{jid} terminated twice"
+        outcomes[jid] = "failed"
+    for jid, _late in pipe.sheds:
+        assert jid not in outcomes, f"{jid} terminated twice"
+        outcomes[jid] = "shed"
+    cons = srv.conservation()
+    assert cons["ok"], cons
+    assert cons["pending"] == 0 and cons["inflight"] == 0
+    assert len(outcomes) == n_jobs, f"{n_jobs - len(outcomes)} vanished"
+    fired_sites = {r.site for r in faults.rules if r.fired}
+    assert fired_sites == {"submit", "pack", "dispatch", "device",
+                           "unpack"}, fired_sites
+    kinds = {"done": 0, "failed": 0, "shed": 0, "rejected": 0}
+    for v in outcomes.values():
+        kinds[v[0] if isinstance(v, tuple) else v] += 1
+    assert kinds["done"] > 0 and kinds["shed"] > 0 \
+        and kinds["rejected"] > 0
+    assert srv.stats.retries > 0
+    # Survivors bit-identical to fault-free: the stub is a pure
+    # function of the graph, so the expected result is exact.
+    for jid, v in outcomes.items():
+        if not isinstance(v, tuple):
+            continue
+        ref = stub_result(graphs[jid])
+        assert v[1].modularity == ref.modularity
+        assert np.array_equal(v[1].communities, ref.communities), jid
+
+
+def test_sticky_shape_union_survives_out_of_order_recording():
+    """The pipelined interleaving: batch B packs (reading the sticky
+    union) BEFORE batch A's execute records its geometry.  Recording
+    must UNION with the current state, not overwrite — a grow-only
+    geometry can never shrink, whatever order the executes land in."""
+    from cuvite_tpu.core.batch import bucket_shape_for
+    from cuvite_tpu.io.generate import generate_rmat
+
+    rmats = [generate_rmat(8, edge_factor=8, seed=s) for s in (41, 42)]
+    synths = [synthesize_graph(1024, seed=many_seed(5, k))
+              for k in range(2)]
+    srv = LouvainServer(ServeConfig(b_max=2, linger_s=0.0),
+                        clock=FakeClock())
+    for g in rmats + synths:           # one tenant: FIFO pop order
+        srv.submit(g)
+    pa = srv.pack_batch(*srv.pop_due(force=True))   # the rmat pair
+    pb = srv.pack_batch(*srv.pop_due(force=True))   # the synth pair
+    # Execute OUT of pack order: A's (larger) geometry records last-
+    # but-one; B's must not erase it.
+    srv.execute_batch(pa)
+    srv.execute_batch(pb)
+    cls = next(iter(srv._shapes))
+    final = srv._shapes[cls]
+    assert final.fits(bucket_shape_for(rmats)), \
+        "out-of-order recording shrank the sticky union"
+    assert final.fits(bucket_shape_for(synths))
+    assert srv.conservation()["ok"]
+
+
+def test_exec_window_envelope_under_nested_isolation():
+    """Overlap bookkeeping: a nested execute window (poison isolation
+    on the other thread) must not close the envelope the outer window
+    opened — last_exec spans [outer start, last end]."""
+    from cuvite_tpu.serve import ServeStats
+
+    st = ServeStats()
+    st.exec_begins(10.0)
+    st.exec_begins(11.0)               # nested (isolation)
+    st.exec_ends(11.0, 12.0)
+    with st.lock:
+        assert st.exec_since == 10.0   # outer window still open
+    st.exec_ends(10.0, 15.0)
+    with st.lock:
+        assert st.last_exec == (10.0, 15.0)
+        assert st.exec_depth == 0 and st.exec_since is None
+        assert st.device_s == pytest.approx(6.0)  # both windows' busy
+
+
+def test_pipelined_daemon_honors_route_variant(tmp_path):
+    """The concheck seeded-bug contract: replacing _route_results on
+    the daemon INSTANCE must reach the pipelined path too (the serial
+    loop looks it up dynamically; the pipe's route must as well)."""
+    from cuvite_tpu.serve import ServeDaemon
+
+    srv = LouvainServer(ServeConfig(b_max=2, linger_s=0.01,
+                                    engine="fused"),
+                        runner=make_stub_runner())
+    d = ServeDaemon(srv, sock_path=str(tmp_path / "v.sock"))
+    seen = []
+    d._route_results = lambda finished, fails, sheds: seen.append(
+        (list(finished), list(fails), list(sheds)))
+    d.pipe._route([("job-0", object())], [], [])
+    assert seen and seen[0][0][0][0] == "job-0", \
+        "pipelined route ignored the instance-level variant"
+
+
+def test_pipelined_daemon_flag_wiring(tmp_path):
+    from cuvite_tpu.serve import ServeDaemon
+
+    srv = LouvainServer(ServeConfig(b_max=2, linger_s=0.01,
+                                    engine="fused"),
+                        runner=make_stub_runner())
+    d = ServeDaemon(srv, sock_path=str(tmp_path / "p.sock"))
+    assert d.pipelined and d.pipe is not None
+    srv2 = LouvainServer(ServeConfig(b_max=2, linger_s=0.01,
+                                     engine="fused"),
+                         runner=make_stub_runner())
+    d2 = ServeDaemon(srv2, sock_path=str(tmp_path / "s.sock"),
+                     pipelined=False)
+    assert not d2.pipelined and d2.pipe is None
+    # The serial daemon still drains cleanly through the old loop.
+    d2.start()
+    d2.request_drain()
+    summary = d2.serve_forever(timeout=30.0)
+    assert summary["conservation"]["ok"]
+    assert summary["pipeline_depth"] == 1
+    d.start()
+    d.request_drain()
+    summary = d.serve_forever(timeout=30.0)
+    assert summary["conservation"]["ok"]
+    assert summary["pipeline_depth"] == 2
+
+
+# ---------------------------------------------------------------------------
+# serve record schema: pipelined REQUIRED, autotuned_b_max optional
+
+
+@pytest.fixture(scope="module")
+def pipelined_serve_record():
+    from cuvite_tpu.workloads.bench import run_serve_bench
+
+    return run_serve_bench(
+        rate=200.0, b_max=2, edges=512, n_jobs=4, slo_ms=60000.0,
+        admission=True, linger_ms=1.0, budget_s=600.0, platform="cpu",
+        pipelined=True, t_start=time.perf_counter())
+
+
+def test_pipelined_serve_record_schema(pipelined_serve_record):
+    assert validate_record(pipelined_serve_record) == []
+    blk = pipelined_serve_record["serve"]
+    assert blk["pipelined"] is True
+    assert blk["done"] == 4
+    assert "overlap_frac" in blk and "pack_s" in blk
+    # pipelined is REQUIRED on every serve record now
+    rec = json.loads(json.dumps(pipelined_serve_record))
+    del rec["serve"]["pipelined"]
+    assert any("pipelined" in p for p in validate_record(rec))
+    rec = json.loads(json.dumps(pipelined_serve_record))
+    rec["serve"]["pipelined"] = "yes"
+    assert any("pipelined" in p for p in validate_record(rec))
+    rec = json.loads(json.dumps(pipelined_serve_record))
+    rec["serve"]["autotuned_b_max"] = 0
+    assert any("autotuned_b_max" in p for p in validate_record(rec))
+    rec["serve"]["autotuned_b_max"] = 4
+    assert validate_record(rec) == []
+
+
+def _round_log(path, rec):
+    with open(path, "w") as f:
+        json.dump({"n": 98, "cmd": "test", "rc": 0, "tail": "",
+                   "parsed": rec}, f)
+
+
+def test_perf_regress_separates_pipeline_modes(tmp_path,
+                                               pipelined_serve_record):
+    """Serial and pipelined serve records never gate each other: a
+    pipelined trajectory far above the serial one must not flag a
+    fresh serial record (and vice versa)."""
+    fresh = json.loads(json.dumps(pipelined_serve_record))
+    fresh["serve"]["pipelined"] = False          # a serial record
+    peer = json.loads(json.dumps(pipelined_serve_record))
+    peer["serve"]["goodput_jobs_per_s"] = \
+        pipelined_serve_record["serve"]["goodput_jobs_per_s"] * 100
+    fresh_p = tmp_path / "fresh.json"
+    fresh_p.write_text(json.dumps(fresh))
+    _round_log(tmp_path / "BENCH_r98.json", peer)
+    out = subprocess.run(
+        [sys.executable, PERF_REGRESS, "--record", str(fresh_p),
+         "--bench-glob", str(tmp_path / "BENCH_r9*.json")],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 comparable" in out.stdout
+    # Same mode still gates.
+    _round_log(tmp_path / "BENCH_r98.json",
+               json.loads(json.dumps(fresh)))
+    out = subprocess.run(
+        [sys.executable, PERF_REGRESS, "--record", str(fresh_p),
+         "--bench-glob", str(tmp_path / "BENCH_r9*.json")],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "1 comparable" in out.stdout

@@ -133,9 +133,17 @@ def comparable(fresh: dict, rec: dict) -> bool:
     # Open-loop serving records (ISSUE 11) gate like-for-like only:
     # same batch cap, same admission arm (on/off are DIFFERENT
     # experiments — the off arm exists to show unbounded wait growth),
-    # same SLO, same job shape, same engine.  Arrival rate is NOT
-    # matched: each round offers its own (saturation-derived) rate and
-    # goodput is the gated capacity number.
+    # same SLO, same job shape, same engine, and — since ISSUE 14 —
+    # same dispatcher architecture: serial and pipelined serve records
+    # never gate each other (the pipelined goodput sits above the
+    # serial one BY DESIGN, so mixing them would either mask a
+    # pipeline regression behind the serial floor or flag every serial
+    # record against the pipelined best).  A record with no
+    # `pipelined` tag predates ISSUE 14 and ran the serial dispatcher
+    # — default it so the historical trajectory keeps gating fresh
+    # serial records.  Arrival rate is NOT matched: each round offers
+    # its own (saturation-derived) rate and goodput is the gated
+    # capacity number.
     fs, rs = fresh.get("serve"), rec.get("serve")
     if (fs is None) != (rs is None):
         return False
@@ -143,6 +151,9 @@ def comparable(fresh: dict, rec: dict) -> bool:
         for k in ("b_max", "admission", "slo_ms", "edges_each", "engine"):
             if fs.get(k) != rs.get(k):
                 return False
+        if bool(fs.get("pipelined", False)) != bool(rs.get("pipelined",
+                                                           False)):
+            return False
     return True
 
 

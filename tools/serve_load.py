@@ -87,7 +87,8 @@ def _sweep_run(args):
     reports, best = saturation_sweep(
         make_server, lambda: graphs, start_rate=args.start_rate,
         slo_s=args.slo_ms / 1e3, growth=args.growth,
-        max_rounds=args.max_rounds)
+        max_rounds=args.max_rounds,
+        pipelined=getattr(args, "pipeline", "off") == "on")
     for rep in reports:
         print(json.dumps(rep.row()))
     if best is None:
@@ -131,7 +132,8 @@ def cmd_ab(args) -> int:
             n_jobs=args.ab_jobs, seed=args.seed, slo_ms=args.slo_ms,
             admission=arm, linger_ms=args.linger_ms,
             engine=args.engine, platform=args.platform,
-            budget_s=args.budget)
+            budget_s=args.budget,
+            pipelined=args.pipeline == "on")
         problems = validate_record(rec)
         if problems:
             print(f"# invalid record ({arm=}): {problems}",
@@ -156,6 +158,65 @@ def cmd_ab(args) -> int:
         "noadmit_slo_met": off["slo_met"],
         "acceptance": bool(on["slo_met"] and on["reject_rate"] > 0
                            and not off["slo_met"]),
+    }
+    print(json.dumps({"verdict": verdict}))
+    return 0 if verdict["acceptance"] else 1
+
+
+def cmd_pipeab(args) -> int:
+    """THE ISSUE-14 acceptance A/B: pipelined vs serial dispatcher on
+    the SAME seeded job set at the same saturating offered rate
+    (admission off, so goodput == measured capacity, not an intake
+    policy).  Emits one schema-v4 serve record per arm (separated by
+    serve.pipelined in perf_regress) and a verdict line with the
+    speedup + the measured pack_s/device_s ratio the acceptance
+    criterion is conditioned on (overlap can only buy up to
+    (pack+device)/max(pack, device))."""
+    from cuvite_tpu.workloads.bench import run_serve_bench, validate_record
+
+    _graphs, _mk, reports, best = _sweep_run(args)
+    if best is None:
+        return 1
+    sat = max(best.rate, *(r.goodput_jobs_per_s for r in reports))
+    rate = args.overload_factor * sat
+    print(json.dumps({"serial_saturation_jobs_per_s": round(sat, 3),
+                      "ab_rate": round(rate, 3)}))
+    out = {}
+    for pipe in (False, True):
+        rec = run_serve_bench(
+            rate=rate, b_max=args.b_max, edges=args.edges,
+            n_jobs=args.ab_jobs, seed=args.seed, slo_ms=args.slo_ms,
+            admission=False, linger_ms=args.linger_ms,
+            engine=args.engine, platform=args.platform,
+            budget_s=args.budget, pipelined=pipe)
+        problems = validate_record(rec)
+        if problems:
+            print(f"# invalid record (pipelined={pipe}): {problems}",
+                  file=sys.stderr)
+            return 2
+        out[pipe] = rec
+        line = json.dumps(rec)
+        print(line)
+        if args.out_prefix:
+            suffix = "pipelined" if pipe else "serial"
+            path = f"{args.out_prefix}_{suffix}.json"
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(line + "\n")
+            print(f"# wrote {path}", file=sys.stderr)
+    ser, pip = out[False]["serve"], out[True]["serve"]
+    speedup = pip["goodput_jobs_per_s"] / max(ser["goodput_jobs_per_s"],
+                                              1e-9)
+    ratio = ser["pack_s"] / max(ser["device_s"], 1e-9)
+    verdict = {
+        "serial_goodput_jobs_per_s": ser["goodput_jobs_per_s"],
+        "pipelined_goodput_jobs_per_s": pip["goodput_jobs_per_s"],
+        "speedup": round(speedup, 3),
+        "pack_over_device": round(ratio, 3),
+        "overlap_frac": pip.get("overlap_frac"),
+        # The conditional acceptance form (ISSUE 14): >= 1.25x is
+        # demanded only when pack is at least half of device — below
+        # that, perfect overlap cannot reach 1.25x arithmetically.
+        "acceptance": bool(speedup >= 1.25 or ratio < 0.5),
     }
     print(json.dumps({"verdict": verdict}))
     return 0 if verdict["acceptance"] else 1
@@ -191,6 +252,7 @@ def cmd_daemon(args) -> int:
            "--port", "0", "--b-max", str(args.b_max),
            "--linger-ms", str(args.linger_ms),
            "--engine", args.engine,
+           "--pipeline", args.pipeline,
            "--host-devices", str(args.host_devices)]
     if args.slo_ms > 0:
         cmd += ["--wait-slo-ms", str(args.slo_ms)]
@@ -266,6 +328,7 @@ def cmd_daemon(args) -> int:
             "daemon": True,
             "b_max": args.b_max,
             "engine": args.engine,
+            "pipelined": args.pipeline == "on",
             "arrival_jobs_per_s": round(args.rate, 3),
             "offered": args.jobs,
             "done": stats.get("jobs_done", events["result"]),
@@ -308,6 +371,11 @@ def _build_parser() -> argparse.ArgumentParser:
         q.add_argument("--engine", default="bucketed",
                        choices=["bucketed", "fused"])
         q.add_argument("--host-devices", type=int, default=8)
+        q.add_argument("--pipeline", default="off", choices=["on", "off"],
+                       help="two-stage pipelined dispatch (ISSUE 14): "
+                            "sweep/ab run the in-process dispatcher in "
+                            "this mode; daemon forwards it to the "
+                            "spawned daemon CLI")
 
     sw = sub.add_parser("sweep", help="find max sustainable jobs/s")
     common(sw)
@@ -330,6 +398,24 @@ def _build_parser() -> argparse.ArgumentParser:
     ab.add_argument("--out-prefix", default=None,
                     help="write <prefix>_admit.json / <prefix>_noadmit.json")
 
+    pab = sub.add_parser("pipeab",
+                         help="pipelined-vs-serial dispatcher A/B at a "
+                              "saturating rate (ISSUE 14 acceptance)")
+    common(pab)
+    pab.add_argument("--start-rate", type=float, default=4.0)
+    pab.add_argument("--growth", type=float, default=1.5)
+    pab.add_argument("--max-rounds", type=int, default=12)
+    pab.add_argument("--overload-factor", type=float, default=1.5,
+                     help="offered rate = factor * measured serial "
+                          "saturation (must exceed BOTH arms' capacity "
+                          "so goodput reads capacity, not arrival)")
+    pab.add_argument("--ab-jobs", type=int, default=256)
+    pab.add_argument("--platform", default="cpu")
+    pab.add_argument("--budget", type=float, default=600.0)
+    pab.add_argument("--out-prefix", default=None,
+                     help="write <prefix>_serial.json / "
+                          "<prefix>_pipelined.json")
+
     dm = sub.add_parser("daemon",
                         help="drive a spawned serve daemon over its socket")
     common(dm)
@@ -349,6 +435,8 @@ def main(argv=None) -> int:
         return cmd_sweep(args)
     if args.cmd == "ab":
         return cmd_ab(args)
+    if args.cmd == "pipeab":
+        return cmd_pipeab(args)
     return cmd_daemon(args)
 
 

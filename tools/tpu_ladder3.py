@@ -284,34 +284,43 @@ def stage_g():
 
 
 def stage_h():
-    """Staged on-chip saturation run (ISSUE 11): the open-loop load
-    generator drives the async daemon over its socket at B in {8, 64},
-    SIGTERMs it, and verifies the graceful drain — so the first
-    platform=tpu serving record includes an SLO row (goodput at an
-    offered rate, wait_p95 vs the 500 ms SLO, reject/shed counts,
-    daemon exit code).  Each B writes its own JSON the moment it
-    exists; rates start conservative (the CPU saturation numbers in
-    BASELINE.md round-13) — the point is the SLO row and the clean
-    drain on chip, not a chip-side sweep."""
+    """Staged on-chip saturation run (ISSUE 11, extended to the
+    pipeline A/B by ISSUE 14): the open-loop load generator drives the
+    async daemon over its socket at B in {8, 64} with the PIPELINED
+    and the SERIAL dispatcher on the SAME seeded job set, SIGTERMs
+    each, and verifies the graceful drain — so the first platform=tpu
+    serving record includes an SLO row per arm (goodput at an offered
+    rate, wait_p95 vs the 500 ms SLO, reject/shed counts, daemon exit
+    code) and the on-chip pack-vs-execute overlap verdict.  Each
+    (B, arm) writes its own JSON the moment it exists; rates start
+    conservative (the CPU saturation numbers in BASELINE.md round-13)
+    — the point is the SLO row and the clean drain on chip, not a
+    chip-side sweep."""
     for b, rate in ((8, 20.0), (64, 60.0)):
-        out_path = os.path.join(REPO, f"tools/serve_tpu_daemon_b{b}.json")
-        t0 = time.perf_counter()
-        try:
-            out = subprocess.run(
-                [sys.executable,
-                 os.path.join(REPO, "tools", "serve_load.py"), "daemon",
-                 "--b-max", str(b), "--rate", str(rate),
-                 "--jobs", "128", "--edges", "4096",
-                 "--slo-ms", "500", "--tenants", "4",
-                 "--out", out_path],
-                capture_output=True, text=True, timeout=1800, cwd=REPO)
-        except subprocess.TimeoutExpired:
-            log(f"H: daemon B={b} TIMEOUT (1800s)")
-            continue
-        last = out.stdout.strip().splitlines()
-        log(f"H: daemon B={b} rate={rate} rc={out.returncode} "
-            f"wall={time.perf_counter()-t0:.0f}s "
-            f"json={last[-1] if last else out.stderr[-200:]}")
+        for pipe in ("on", "off"):
+            out_path = os.path.join(
+                REPO, f"tools/serve_tpu_daemon_pipe{pipe}_b{b}.json")
+            t0 = time.perf_counter()
+            try:
+                out = subprocess.run(
+                    [sys.executable,
+                     os.path.join(REPO, "tools", "serve_load.py"),
+                     "daemon",
+                     "--b-max", str(b), "--rate", str(rate),
+                     "--jobs", "128", "--edges", "4096",
+                     "--slo-ms", "500", "--tenants", "4",
+                     "--pipeline", pipe,
+                     "--out", out_path],
+                    capture_output=True, text=True, timeout=1800,
+                    cwd=REPO)
+            except subprocess.TimeoutExpired:
+                log(f"H: daemon B={b} pipeline={pipe} TIMEOUT (1800s)")
+                continue
+            last = out.stdout.strip().splitlines()
+            log(f"H: daemon B={b} pipeline={pipe} rate={rate} "
+                f"rc={out.returncode} "
+                f"wall={time.perf_counter()-t0:.0f}s "
+                f"json={last[-1] if last else out.stderr[-200:]}")
 
 
 def main():
