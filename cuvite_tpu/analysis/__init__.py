@@ -24,6 +24,15 @@ Layout (the three tiers, ANALYSIS.md "Tiers"):
   cache.py       — incremental lint cache (content-hash keyed)
   jaxpr_audit.py — tier 3: jaxpr lint + compile-budget audit (J*/B*
                    findings; driven by tools/compile_audit.py)
+  meshspec.py    — tier 5 (static): SPMD mesh/collective analysis —
+                   axis-name drift (R023), whole-program collective-
+                   order divergence (R024), replication audit (R025 +
+                   the replicated-ok inventory)
+  meshcheck.py   — tier 5 (dynamic): the mesh audit — real sharded
+                   entries across virtual mesh shapes, graded M001
+                   (collective sequences), M002 (label neutrality),
+                   M003 (per-device HBM scaling laws); driven by
+                   tools/mesh_audit.py
   __main__.py    — CLI: python -m cuvite_tpu.analysis [paths] [options]
 
 See ANALYSIS.md at the repo root for the rule catalogue, suppression
@@ -44,11 +53,12 @@ from cuvite_tpu.analysis.engine import (
 
 # Importing the rule modules populates the registry as a side effect
 # (tier 1 lexical rules, tier 2 cross-module rules, tier 2b lockset,
-# tier 4 static lock-order/atomicity).
+# tier 4 static lock-order/atomicity, tier 5 static mesh/collective).
 from cuvite_tpu.analysis import rules as _rules        # noqa: F401
 from cuvite_tpu.analysis import callgraph as _cg       # noqa: F401
 from cuvite_tpu.analysis import lockset as _lockset    # noqa: F401
 from cuvite_tpu.analysis import lockorder as _lockord  # noqa: F401
+from cuvite_tpu.analysis import meshspec as _meshspec  # noqa: F401
 from cuvite_tpu.analysis.callgraph import (
     run_project,
     run_project_sources,

@@ -68,7 +68,7 @@ JIT_ENTRY_CALLS = set(_JIT_NAMES) | {
     "shard_map", "jax.experimental.shard_map.shard_map",
 }
 
-SUMMARY_VERSION = 4
+SUMMARY_VERSION = 6
 
 
 def module_of(rel: str) -> str:
@@ -264,12 +264,12 @@ def summarize(sf: SourceFile) -> dict:
             "sync_sites": facts["sync"],
             "pull_sites": facts["pull"],
         })
-    # Tier-4 static facts ride the same summary (and therefore the same
-    # incremental-cache entry): the R020 acquisition graph is rebuilt
-    # from cached lock summaries exactly like R017/R018 are from the
-    # dataflow ones.  Lazy import: lockorder subclasses ProjectRule from
-    # THIS module.
-    from cuvite_tpu.analysis import lockorder
+    # Tier-4/5 static facts ride the same summary (and therefore the
+    # same incremental-cache entry): the R020 acquisition graph and the
+    # R023-R025 mesh facts are rebuilt from cached summaries exactly
+    # like R017/R018 are from the dataflow ones.  Lazy import: both
+    # modules subclass ProjectRule from THIS module.
+    from cuvite_tpu.analysis import lockorder, meshspec
 
     return {
         "version": SUMMARY_VERSION,
@@ -280,6 +280,7 @@ def summarize(sf: SourceFile) -> dict:
         "entry_wraps": entry_wraps,
         "functions": funcs,
         "locks": lockorder.lock_summary(sf),
+        "mesh": meshspec.mesh_summary(sf),
         "suppress": {str(ln): sorted(ids)
                      for ln, ids in sf._line_suppress.items()},
         "file_suppress": sorted(sf._file_suppress),

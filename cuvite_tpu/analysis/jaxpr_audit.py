@@ -159,12 +159,19 @@ def tiny_graphs(b: int = 2, nv: int = 256, ne: int = 1024,
 # Jaxpr tracing of the real batched-phase programs.
 
 
-def trace_phase_jaxprs(b: int = 2, nv: int = 256, ne: int = 1024) -> dict:
+def trace_phase_jaxprs(b: int = 2, nv: int = 256, ne: int = 1024,
+                       mesh=None, programs=None) -> dict:
     """{name: ClosedJaxpr} for the real batched per-phase programs at
     the representative class — the fused body, the bucketed phase-0
     body, and the coarse-class shrink.  Arg construction mirrors
     ``run_batched``'s upload block (host numpy stands in for the device
-    placement; shapes and dtypes are identical)."""
+    placement; shapes and dtypes are identical).  ``mesh`` (a 1-D
+    batch-axis Mesh) traces the SHARDED program the tier-5 mesh audit
+    inspects — the shard_map body's collective sequence then appears in
+    the jaxpr exactly as the compiled entry issues it.  ``programs``
+    restricts to a subset of the three names (the mesh audit consumes
+    one per entry; the bucket-plan build for an untraced program is
+    pure waste)."""
     import jax
 
     from cuvite_tpu.core.batch import batch_bucket_plans, batch_slabs
@@ -190,29 +197,37 @@ def trace_phase_jaxprs(b: int = 2, nv: int = 256, ne: int = 1024) -> dict:
                  batch.real_mask, prev, batch.row_valid, batch.constant,
                  np.asarray(1.0e-6, dtype=wdt))
 
+    want = set(programs) if programs is not None else {
+        "batched_fused_phase", "batched_bucketed_phase0",
+        "batched_coarse_shrink"}
     out = {}
-    fused = _get_batched_phase(None, nv_pad, adt, eng,
-                               MAX_TOTAL_ITERATIONS)
-    out["batched_fused_phase"] = jax.make_jaxpr(fused)(*slab_args)
+    if "batched_fused_phase" in want:
+        fused = _get_batched_phase(mesh, nv_pad, adt, eng,
+                                   MAX_TOTAL_ITERATIONS)
+        out["batched_fused_phase"] = jax.make_jaxpr(fused)(*slab_args)
 
-    bplan = batch_bucket_plans(batch)
-    plan_args = (
-        tuple((v.astype(np.int32), d, ww) for v, d, ww in bplan.buckets),
-        tuple(bplan.heavy),
-        bplan.self_loop,
-        bplan.perm,
-    )
-    bucketed = _get_batched_phase(None, nv_pad, adt, eng,
-                                  MAX_TOTAL_ITERATIONS,
-                                  engine="bucketed",
-                                  n_buckets=len(bplan.buckets))
-    out["batched_bucketed_phase0"] = jax.make_jaxpr(bucketed)(
-        *plan_args, *slab_args)
+    if "batched_bucketed_phase0" in want:
+        bplan = batch_bucket_plans(batch)
+        plan_args = (
+            tuple((v.astype(np.int32), d, ww)
+                  for v, d, ww in bplan.buckets),
+            tuple(bplan.heavy),
+            bplan.self_loop,
+            bplan.perm,
+        )
+        bucketed = _get_batched_phase(mesh, nv_pad, adt, eng,
+                                      MAX_TOTAL_ITERATIONS,
+                                      engine="bucketed",
+                                      n_buckets=len(bplan.buckets))
+        out["batched_bucketed_phase0"] = jax.make_jaxpr(bucketed)(
+            *plan_args, *slab_args)
 
-    cnv, cne = _coarse_class(nv_pad, batch.ne_pad)
-    out["batched_coarse_shrink"] = jax.make_jaxpr(
-        lambda s, d, w, m: _shrink_batch(s, d, w, m, cnv=cnv, cne=cne))(
-        batch.src, batch.dst, batch.w, batch.real_mask)
+    if "batched_coarse_shrink" in want:
+        cnv, cne = _coarse_class(nv_pad, batch.ne_pad)
+        out["batched_coarse_shrink"] = jax.make_jaxpr(
+            lambda s, d, w, m: _shrink_batch(s, d, w, m, cnv=cnv,
+                                             cne=cne))(
+            batch.src, batch.dst, batch.w, batch.real_mask)
     return out
 
 

@@ -1,0 +1,561 @@
+"""Tier 5 (dynamic half) — the mesh audit (M001-M003).
+
+The static half (analysis/meshspec.py: R023-R025) reads source; this
+module runs the REAL sharded entries — the bucketed SPMD step under
+both exchanges (plus the env-driven auto cutover) and the batched
+fused/bucketed phase programs — across several virtual mesh shapes and
+grades three properties no AST walk can check:
+
+  * **M001 — collective-sequence integrity.**  The per-shard collective
+    sequence is extracted from the traced jaxpr (shard_map bodies,
+    while/cond sub-jaxprs included, in program order).  Under SPMD
+    every shard executes the one program, so per-shard divergence can
+    only enter through data-dependent control flow: a ``cond`` whose
+    branches issue different collective subsequences is a conviction
+    (the "conditional psum" sabotage), and so is a sequence that
+    changes STRUCTURE across mesh shapes (same program, different
+    collective order = a shape-keyed schedule fork).
+
+  * **M002 — mesh-shape label neutrality.**  Labels and modularity must
+    be bit-identical across every audited mesh shape — the hand-written
+    mesh-neutrality pins in tests/test_batched.py generalized into a
+    closed gate over ALL sharded entries (:func:`assert_mesh_neutral`
+    is the one shared implementation those tests now call).
+
+  * **M003 — replication scaling.**  The HBM ledger's per-device column
+    (obs/memory.py::per_device_nbytes) is graded against the declared
+    per-category scaling law in ``tools/replication_budget.json``: a
+    category declared ``sharded`` must shrink ~1/S as the mesh grows; a
+    category declared ``replicated`` is allowed but must be LISTED —
+    the manifest is the closed inventory.  "The community table is
+    O(nv_total) per chip" (round-8) is now a failing test, not a note.
+
+Dynamic results are NEVER cached (the concheck precedent): every audit
+re-runs the entries; only the static tier rides the incremental lint
+cache.  ``tools/mesh_audit.py`` is the CLI; the tier-1 gate
+(tests/test_meshcheck.py) runs the same audit in-process on the
+forced-CPU 8-virtual-device shape.
+
+Finding ids here (M*) are OUTSIDE the R-rule registry, like the tier-3
+J*/B* ids: they anchor on entries/shapes, not source lines.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+
+import numpy as np
+
+from cuvite_tpu.analysis.engine import Finding
+
+# (spmd_axis_size, spare) factorizations of tier-1's 8-virtual-device
+# pool: the 1-D entries use the first dim (vertex shards for the solo
+# step, batch shards for the batched programs); the second dim is the
+# idle remainder — the shape a future two-level ICI/DCN mesh would
+# claim.
+MESH_SHAPES = ((8, 1), (4, 2), (2, 4))
+
+BUDGET_VERSION = 1
+
+DEFAULT_BUDGET_REL = os.path.join("tools", "replication_budget.json")
+
+# Scaling-law tolerance: measured per-device bytes for a 'sharded'
+# category may exceed global/S by this factor plus the absolute floor
+# (replicated scalars like the 1/(2m) constant ride in 'tables').
+SHARDED_TOL = 1.5
+SHARDED_FLOOR_BYTES = 4096
+
+# Jaxpr primitives that are cross-shard collectives (communication
+# order matters) — the dynamic twin of meshspec.SPMD_COLLECTIVES.
+COLLECTIVE_PRIM_MARKERS = (
+    "psum", "all_to_all", "all_gather", "ppermute", "pmin", "pmax",
+    "reduce_scatter", "all_reduce", "collective_permute",
+)
+
+
+def _is_collective(prim_name: str) -> bool:
+    return any(m in prim_name for m in COLLECTIVE_PRIM_MARKERS)
+
+
+def _axes_of(eqn) -> tuple:
+    p = eqn.params
+    ax = p.get("axes", p.get("axis_name"))
+    if ax is None:
+        return ()
+    if isinstance(ax, (tuple, list)):
+        return tuple(str(a) for a in ax)
+    return (str(ax),)
+
+
+def _subjaxprs_of(value):
+    from cuvite_tpu.analysis.jaxpr_audit import _sub_jaxprs
+
+    return _sub_jaxprs(value)
+
+
+def collective_sequence(jaxpr):
+    """(sequence, branch_divergences) for one traced program.
+
+    ``sequence`` is a nested tuple in deterministic program order:
+    ``("psum", ("v",))`` for a collective, ``("while", (...))`` /
+    ``("cond", ((...), (...)))`` wrapping control-flow bodies (a while
+    body executes a data-dependent NUMBER of times, but the same
+    number on every shard when its predicate is replicated — the
+    structure, not the trip count, is the invariant).
+
+    ``branch_divergences`` lists every cond whose branches issue
+    DIFFERENT collective subsequences — the one way a single SPMD
+    program can put shards into different collective orders.
+    """
+    divergences = []
+
+    def walk(jx):
+        core = getattr(jx, "jaxpr", jx)
+        seq = []
+        for eqn in getattr(core, "eqns", ()):
+            name = eqn.primitive.name
+            if _is_collective(name):
+                seq.append((name, _axes_of(eqn)))
+                continue
+            if name == "cond":
+                branches = [walk(b) for b in eqn.params.get("branches", ())]
+                if len(set(branches)) > 1 and any(
+                        _has_collective(b) for b in branches):
+                    divergences.append(
+                        ("cond", tuple(branches)))
+                if any(branches):
+                    seq.append(("cond", tuple(branches)))
+                continue
+            if name in ("while", "scan"):
+                subs = []
+                for key in sorted(eqn.params):
+                    for sub in _subjaxprs_of(eqn.params[key]):
+                        subs.extend(walk(sub))
+                if subs:
+                    seq.append((name, tuple(subs)))
+                continue
+            # Generic recursion (pjit bodies, shard_map bodies, custom
+            # calls): inline the sub-sequence in param-key order.
+            for key in sorted(eqn.params):
+                for sub in _subjaxprs_of(eqn.params[key]):
+                    seq.extend(walk(sub))
+        return tuple(seq)
+
+    seq = walk(jaxpr)
+    return seq, divergences
+
+
+def _has_collective(seq) -> bool:
+    return bool(_flat_names(seq))
+
+
+def _mfind(rule: str, entry: str, message: str, snippet: str = "") -> Finding:
+    return Finding(rule=rule, severity="high", path=f"<mesh:{entry}>",
+                   line=0, message=message, snippet=snippet)
+
+
+def lint_collective_jaxpr(jaxpr, entry: str) -> list:
+    """M001 findings intrinsic to ONE program: collectives under
+    branch-divergent control flow (the conditional-psum class)."""
+    _seq, div = collective_sequence(jaxpr)
+    out = []
+    for kind, branches in div:
+        out.append(_mfind(
+            "M001", entry,
+            f"'{entry}' issues collectives under a data-dependent "
+            f"'{kind}' whose branches differ "
+            f"({[_flat_sigs(b) for b in branches]}): shards taking "
+            "different branches issue different collective sequences — "
+            "the canonical SPMD deadlock (R024's runtime twin)",
+            snippet=kind))
+    return out
+
+
+def _flat_sigs(node) -> list:
+    """Collective signatures ``'psum(v)'`` in a sequence tree, in
+    order — the axes stay visible so two sequences that differ ONLY in
+    axis names (the ICI/DCN rename class) render differently in the
+    M001 message.  ``node`` is either an ITEM — ``("psum", axes)`` /
+    ``("cond", (branch, ...))`` / ``("while", (item, ...))`` — or a
+    (possibly empty) tuple of items/branches.  Axes tuples are all-str
+    and skipped when recursing; empty branches contribute nothing (a
+    cond with a collective-free branch is exactly the M001 conviction
+    shape and must flatten, not crash)."""
+    out: list = []
+    if not isinstance(node, tuple):
+        return out
+    if node and isinstance(node[0], str):
+        if _is_collective(node[0]):
+            axes = [sub for sub in node[1:]
+                    if isinstance(sub, tuple)
+                    and all(isinstance(s, str) for s in sub)]
+            out.append(f"{node[0]}({','.join(axes[0]) if axes else ''})")
+        for sub in node[1:]:
+            if isinstance(sub, tuple) \
+                    and not all(isinstance(s, str) for s in sub):
+                out.extend(_flat_sigs(sub))
+        return out
+    for sub in node:
+        out.extend(_flat_sigs(sub))
+    return out
+
+
+def _flat_names(node) -> list:
+    """Primitive names only (axes stripped) — the membership view."""
+    return [sig.partition("(")[0] for sig in _flat_sigs(node)]
+
+
+def check_sequences(entry: str, seq_by_shape: dict) -> list:
+    """M001: the collective sequence must be structurally identical at
+    every mesh shape (axis names and order; operand shapes legitimately
+    scale with the mesh and are excluded by construction)."""
+    tags = sorted(seq_by_shape)
+    if len({seq_by_shape[t] for t in tags}) <= 1:
+        return []
+    a, b = tags[0], next(t for t in tags[1:]
+                         if seq_by_shape[t] != seq_by_shape[tags[0]])
+    return [_mfind(
+        "M001", entry,
+        f"'{entry}' traces DIFFERENT collective sequences at mesh "
+        f"shapes {a} and {b} ({_flat_sigs(seq_by_shape[a])} vs "
+        f"{_flat_sigs(seq_by_shape[b])}): the schedule forked on the "
+        "mesh shape — every rank/shape must issue the identical "
+        "sequence (arXiv:1702.04645's synchronized-collective "
+        "contract)")]
+
+
+def check_labels(entry: str, labels_by_shape: dict) -> list:
+    """M002: per-tenant labels and modularity bit-identical across
+    shapes.  ``labels_by_shape``: {tag: [(labels, q), ...]}."""
+    tags = sorted(labels_by_shape)
+    if not tags:
+        return []
+    ref_tag = tags[0]
+    ref = labels_by_shape[ref_tag]
+    out = []
+    for tag in tags[1:]:
+        got = labels_by_shape[tag]
+        if len(got) != len(ref):
+            out.append(_mfind(
+                "M002", entry,
+                f"'{entry}' returned {len(got)} results at shape {tag} "
+                f"vs {len(ref)} at {ref_tag}"))
+            continue
+        for i, ((la, qa), (lb, qb)) in enumerate(zip(ref, got)):
+            if not np.array_equal(np.asarray(la), np.asarray(lb)):
+                out.append(_mfind(
+                    "M002", entry,
+                    f"'{entry}' labels for job {i} differ between mesh "
+                    f"shapes {ref_tag} and {tag}: the mesh changed WHAT "
+                    "was computed, not just where — mesh-shape "
+                    "neutrality is the serving contract every sharded "
+                    "entry must keep"))
+                break
+            if qa != qb:
+                out.append(_mfind(
+                    "M002", entry,
+                    f"'{entry}' modularity for job {i} differs between "
+                    f"{ref_tag} ({qa!r}) and {tag} ({qb!r}) with equal "
+                    "labels: a mesh-shape-dependent reduction order "
+                    "leaked into the scalar"))
+                break
+    return out
+
+
+def check_replication(entry: str, ledger_by_shape: dict,
+                      manifest: dict) -> list:
+    """M003: per-device ledger bytes vs the declared scaling law.
+
+    ``ledger_by_shape``: {tag: {"devices": n, "categories":
+    {cat: {"global": g, "per_device": p}}}}."""
+    cats = manifest.get("categories", {})
+    out = []
+    seen = set()
+    for tag in sorted(ledger_by_shape):
+        rep = ledger_by_shape[tag]
+        n = max(int(rep.get("devices", 1)), 1)
+        for cat, row in sorted(rep.get("categories", {}).items()):
+            g = int(row.get("global", 0))
+            p = int(row.get("per_device", g))
+            if g <= SHARDED_FLOOR_BYTES:
+                continue
+            law = cats.get(cat, {}).get("law")
+            if law is None:
+                if cat not in seen:
+                    seen.add(cat)
+                    out.append(_mfind(
+                        "M003", entry,
+                        f"'{entry}' tracked HBM category '{cat}' which "
+                        "is not in tools/replication_budget.json: the "
+                        "replication inventory is CLOSED — declare the "
+                        "category's scaling law (sharded/replicated) "
+                        "deliberately",
+                        snippet=cat))
+                continue
+            if law == "sharded" and n > 1:
+                allowed = g / n * SHARDED_TOL + SHARDED_FLOOR_BYTES
+                if p > allowed:
+                    out.append(_mfind(
+                        "M003", entry,
+                        f"'{entry}' at mesh shape {tag}: category "
+                        f"'{cat}' holds {p} bytes per device but its "
+                        f"declared law is 'sharded' (global {g} over "
+                        f"{n} devices allows ~{int(allowed)}): an "
+                        "unsharded O(nv)-scale buffer is riding a "
+                        "sharded entry — the per-chip HBM wall class "
+                        "round-8 measured; shard it or declare it "
+                        "'replicated' with a reason",
+                        snippet=cat))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Manifest.
+
+
+def load_budget(path: str) -> dict:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    if data.get("version") != BUDGET_VERSION:
+        raise ValueError(f"replication budget {path!r}: unsupported "
+                         f"version {data.get('version')!r}")
+    return data
+
+
+def write_budget(path: str, categories: dict, env: dict) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": BUDGET_VERSION, "env": env,
+                   "categories": categories}, fh, indent=2,
+                  sort_keys=True)
+        fh.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# Entry execution.
+
+
+class ShapeReport:
+    """One (entry, mesh shape) observation."""
+
+    def __init__(self, tag: str, devices: int):
+        self.tag = tag
+        self.devices = devices
+        self.labels: list = []       # [(labels np.ndarray, q float)]
+        self.seq: tuple = ()
+        self.intrinsic: list = []    # M001 findings from the jaxpr
+        self.categories: dict = {}   # cat -> {"global", "per_device"}
+
+    def ledger_row(self) -> dict:
+        return {"devices": self.devices, "categories": self.categories}
+
+
+def _audit_graph(nv: int = 2048, ne: int = 8192):
+    """The solo-entry audit graph: fixed structure (ring + deterministic
+    extras), big enough that per-category sharding is measurable, small
+    enough that six sharded step compiles stay in tier-1 budget."""
+    from cuvite_tpu.analysis.jaxpr_audit import tiny_graphs
+
+    return tiny_graphs(b=1, nv=nv, ne=ne)[0]
+
+
+def _ledger_categories(ledger) -> dict:
+    return {
+        cat: {"global": int(ledger.peak_by_buffer.get(cat, 0)),
+              "per_device": int(ledger.peak_per_device.get(cat, 0))}
+        for cat in ledger.peak_by_buffer
+    }
+
+
+def _recorder():
+    from cuvite_tpu.obs.recorder import NO_TRACE, FlightRecorder
+    from cuvite_tpu.utils.trace import Tracer
+
+    rec = FlightRecorder(NO_TRACE, watch_compiles=False)
+    return rec, Tracer(recorder=rec)
+
+
+@contextlib.contextmanager
+def _env(name: str, value: str | None):
+    prior = os.environ.get(name)
+    try:
+        if value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = value
+        yield
+    finally:
+        if prior is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prior
+
+
+def _solo_report(shape, exchange: str, *, cutover: bool = False):
+    """Run the per-graph bucketed SPMD entry at one mesh shape: labels
+    via the real driver, step jaxpr + HBM ledger via a directly-built
+    PhaseRunner (the same factory the driver uses)."""
+    import jax
+
+    from cuvite_tpu.comm.mesh import make_mesh
+    from cuvite_tpu.core.distgraph import DistGraph
+    from cuvite_tpu.louvain.driver import (
+        PhaseRunner,
+        exchange_cutover,
+        louvain_phases,
+    )
+
+    S = shape[0]
+    g = _audit_graph()
+    report = ShapeReport(f"{shape[0]}x{shape[1]}", S)
+    ctx = _env("CUVITE_EXCHANGE_CUTOVER", "1") if cutover \
+        else contextlib.nullcontext()
+    with ctx:
+        rec, tracer = _recorder()
+        arg_exchange = "auto" if cutover else exchange
+        res = louvain_phases(g, nshards=S, engine="bucketed",
+                             exchange=arg_exchange, max_phases=1,
+                             tracer=tracer, verbose=False)
+        report.labels = [(np.asarray(res.communities),
+                          float(res.modularity))]
+        report.categories = _ledger_categories(rec.ledger)
+        if cutover:
+            dg_probe = DistGraph.build(g, S)
+            if dg_probe.total_padded_vertices < exchange_cutover():
+                report.intrinsic.append(_mfind(
+                    "M000", "bucketed_cutover",
+                    "CUVITE_EXCHANGE_CUTOVER=1 did not resolve "
+                    "exchange='auto' to the sparse plan — the cutover "
+                    "env override is broken"))
+        # The step program actually compiled for this (mesh, exchange):
+        # a second runner re-derives it from the same factory (plan
+        # build + upload only, no execution) so make_jaxpr sees the
+        # real shard_map body.
+        dg = DistGraph.build(g, S)
+        runner = PhaseRunner(dg, mesh=make_mesh(S), engine="bucketed",
+                             exchange=exchange)
+        jaxpr = jax.make_jaxpr(
+            lambda c: runner._call(c, runner._extra))(runner.comm0)
+    report.seq, _ = collective_sequence(jaxpr)
+    report.intrinsic += lint_collective_jaxpr(
+        jaxpr, f"bucketed_{'cutover' if cutover else exchange}")
+    return report
+
+
+def _batched_report(shape, engine: str, b: int = 8):
+    """Run the batched entry (fused or bucketed) with the batch axis
+    over ``shape[0]`` devices; per-tenant labels, phase jaxpr, ledger."""
+    import jax
+
+    from cuvite_tpu.analysis.jaxpr_audit import tiny_graphs, \
+        trace_phase_jaxprs
+    from cuvite_tpu.louvain.batched import BATCH_AXIS, cluster_many
+
+    nd = shape[0]
+    mesh = None
+    if nd > 1:
+        from jax.sharding import Mesh
+
+        mesh = Mesh(np.array(jax.devices()[:nd]), (BATCH_AXIS,))
+    graphs = tiny_graphs(b=b)
+    report = ShapeReport(f"{shape[0]}x{shape[1]}", nd)
+    rec, tracer = _recorder()
+    br = cluster_many(graphs, mesh=mesh, engine=engine, max_phases=2,
+                      tracer=tracer)
+    report.labels = [(np.asarray(r.communities), float(r.modularity))
+                     for r in br.results]
+    report.categories = _ledger_categories(rec.ledger)
+    name = ("batched_bucketed_phase0" if engine == "bucketed"
+            else "batched_fused_phase")
+    jaxpr = trace_phase_jaxprs(b=b, mesh=mesh, programs=[name])[name]
+    report.seq, _ = collective_sequence(jaxpr)
+    report.intrinsic += lint_collective_jaxpr(jaxpr, f"batched_{engine}")
+    return report
+
+
+# Entry registry: name -> callable(shape) -> ShapeReport.  Names are
+# what the CLI's --entries takes and what findings anchor on.
+ENTRIES = {
+    "bucketed_replicated":
+        lambda shape: _solo_report(shape, "replicated"),
+    "bucketed_sparse":
+        lambda shape: _solo_report(shape, "sparse"),
+    "bucketed_cutover":
+        lambda shape: _solo_report(shape, "sparse", cutover=True),
+    "batched_fused":
+        lambda shape: _batched_report(shape, "fused"),
+    "batched_bucketed":
+        lambda shape: _batched_report(shape, "bucketed"),
+}
+
+
+def run_mesh_audit(entry_names=None, shapes=MESH_SHAPES,
+                   budget_path: str | None = None):
+    """(findings, reports) over the audited entries.  ``reports``:
+    {entry: {tag: ShapeReport}}.  Shared by tools/mesh_audit.py and the
+    tier-1 gate — one implementation, one behavior.  Results are NEVER
+    cached: the incremental lint cache holds only static summaries."""
+    if budget_path is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        budget_path = os.path.join(root, DEFAULT_BUDGET_REL)
+    try:
+        manifest = load_budget(budget_path)
+    except (OSError, ValueError) as e:
+        manifest = None
+        manifest_err = str(e)
+    findings: list = []
+    reports: dict = {}
+    names = list(ENTRIES) if entry_names is None else list(entry_names)
+    for name in names:
+        run = ENTRIES[name]
+        by_shape: dict = {}
+        for shape in shapes:
+            try:
+                rep = run(shape)
+            except Exception as e:  # fail CLOSED: a crashing entry is a
+                findings.append(_mfind(  # finding, not a skipped check
+                    "M000", name,
+                    f"entry '{name}' failed at mesh shape "
+                    f"{shape[0]}x{shape[1]}: {type(e).__name__}: {e}"))
+                continue
+            by_shape[rep.tag] = rep
+            findings.extend(rep.intrinsic)
+        reports[name] = by_shape
+        if len(by_shape) >= 2:
+            findings.extend(check_sequences(
+                name, {t: r.seq for t, r in by_shape.items()}))
+            findings.extend(check_labels(
+                name, {t: r.labels for t, r in by_shape.items()}))
+        if manifest is not None:
+            findings.extend(check_replication(
+                name, {t: r.ledger_row() for t, r in by_shape.items()},
+                manifest))
+    if manifest is None:
+        findings.append(_mfind(
+            "M000", "manifest",
+            f"replication budget unreadable ({manifest_err}): the "
+            "scaling-law inventory is the closed artifact — restore "
+            "tools/replication_budget.json or regenerate with "
+            "tools/mesh_audit.py --write-budget"))
+    return findings, reports
+
+
+# ---------------------------------------------------------------------------
+# The shared mesh-neutrality helper (tests/test_batched.py and
+# tests/test_pallas_spmd.py call this instead of hand-rolled loops).
+
+
+def assert_mesh_neutral(run, configs, entry: str = "test") -> None:
+    """Assert ``run(config)`` produces bit-identical (labels, Q) pairs
+    for every config — THE one implementation of "the mesh (or engine)
+    changes where work runs, never what it computes".  ``run`` returns
+    a list of (labels, modularity) pairs (one per job/tenant)."""
+    by_tag = {}
+    for cfg in configs:
+        tag = str(cfg)
+        by_tag[tag] = [(np.asarray(l), q) for (l, q) in run(cfg)]
+    findings = check_labels(entry, by_tag)
+    if findings:
+        raise AssertionError("\n".join(f.format() for f in findings))

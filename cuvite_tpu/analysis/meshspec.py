@@ -1,0 +1,710 @@
+"""Tier 5 (static half) — SPMD mesh/collective analysis (R023-R025).
+
+ROADMAP item 5 (the hierarchical two-level ICI/DCN exchange) is a
+structural SPMD change: it adds a second mesh axis, re-routes
+collectives across it, and re-partitions the replicated community
+tables.  The reference's synchronized-collective design (Bhowmick et
+al., arXiv:1702.04645) rests on every rank issuing the identical
+collective sequence over axes its mesh actually has — and nothing in
+tiers 1-4 machine-checks that.  This module closes the static half
+(analysis/meshcheck.py runs the dynamic half, M001-M003):
+
+**Facts** (:func:`mesh_summary`, riding the tier-2 summary and the
+incremental lint cache exactly like the lock summaries): per file —
+module-level string constants (axis names live in constants:
+``VERTEX_AXIS = "v"``), ``Mesh(...)`` constructions with their
+resolvable axis-name tuples, ``shard_map`` wrap sites (call-site and
+partial-decorator spellings) with the wrapped callable names and the
+axis tokens their ``P(...)`` specs mention, SPMD collective call sites
+(``psum``/``all_to_all``/``all_gather``/``ppermute``/...) with their
+axis argument classified (literal / module constant / enclosing-
+function parameter), and O(nv_total) materialization sites with their
+``# graftlint: replicated-ok=<reason>`` annotations.
+
+**R023 — axis-name drift** (project tier).  A collective's axis name,
+resolved cross-module (parameters chase their call-site bindings
+through the project call graph, depth-bounded), must be (a) an axis of
+*some* constructed mesh, and (b) admitted by at least one of the
+shard_map wraps whose body reaches the collective.  Violation (a) is
+the typo/rename class; violation (b) is the exact bug a two-level
+ICI/DCN split introduces — a helper still issuing ``psum(x, "v")``
+after the mesh became ``("ici", "dcn")``.
+
+**R024 — whole-program collective-order divergence** (project tier).
+R004 lifted off the single file: an SPMD collective under a
+data-dependent or fallible branch (the same divergence classifier R004
+uses, plus ``try``) in ANY function reachable from a shard_map body,
+with the reach chain in the message.  R004 keeps its per-file cases —
+the two rules partition by collective set (host-side multihost
+wrappers stay R004's; device collectives are R024's).
+
+**R025 — replication audit** (project tier).  A device buffer whose
+symbolic size scales with ``nv_total`` (``jnp.zeros((nv_total,))``,
+``segment_sum(..., num_segments=nv_total)``, an ``all_gather`` of a
+sharded table) materialized inside a function reachable from a
+shard_map body is O(total vertices) **per chip** — round-8 measured
+exactly these tables as the wall the sparse cutover exists for.  Every
+such site must carry ``# graftlint: replicated-ok=<reason>`` on its
+line, so the replicated tables form a closed, justified inventory
+(:func:`replicated_inventory`) — the starting point ROADMAP item 5
+needs.  Per-shard ``nv_pad``-sized buffers are sharded by construction
+and out of scope here; the dynamic M003 scaling check covers them.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from cuvite_tpu.analysis.engine import Finding, SourceFile, dotted, register
+from cuvite_tpu.analysis.rules import (
+    COLLECTIVE_NAMES,
+    _condition_is_divergent,
+)
+
+MESH_SUMMARY_VERSION = 2
+
+# Device/SPMD collective primitives (matched on the dotted name's last
+# part).  Host-side multihost wrappers (COLLECTIVE_NAMES) are R004's
+# domain and excluded here, so the two rules never double-report.
+SPMD_COLLECTIVES = {
+    "psum", "pmax", "pmin", "pmean", "all_to_all", "all_gather",
+    "ppermute", "pshuffle", "psum_scatter", "axis_index",
+}
+# axis_index is not a communication op; it anchors axis-name facts but
+# never a divergence finding.
+_ORDERING_COLLECTIVES = SPMD_COLLECTIVES - {"axis_index"}
+
+# Size symbols whose presence in a shape/num_segments expression marks
+# an O(nv_total)-per-chip materialization (R025).  nv_pad/nv_local are
+# per-shard sizes — sharded by construction, dynamic M003's job.
+SIZE_SYMBOLS = ("nv_total",)
+
+_ALLOC_CALLS = {
+    "zeros", "ones", "full", "empty", "arange", "broadcast_to",
+}
+_SEGMENT_PREFIX = "segment_"
+
+_REPL_OK_RE = re.compile(r"#\s*graftlint:\s*replicated-ok\s*=\s*(.+?)\s*$")
+
+
+def _last(name: str | None) -> str:
+    return name.split(".")[-1] if name else ""
+
+
+def _enclosing_with_param(sf: SourceFile, node: ast.AST, name: str):
+    """The nearest enclosing function that binds ``name`` as a
+    parameter, or None — closures see outer-function parameters, so an
+    axis Name inside a nested shard_map body resolves to the FACTORY's
+    parameter (the make_sharded_step shape)."""
+    for anc in sf.ancestors(node):
+        info = sf.func_of_node.get(anc)
+        if info is not None and name in info.params:
+            return info
+    return None
+
+
+def _module_consts(sf: SourceFile) -> dict:
+    """Module-level ``NAME = "str"`` constants (axis names live here:
+    VERTEX_AXIS/BATCH_AXIS)."""
+    out: dict = {}
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _axis_token(sf: SourceFile, consts: dict, node: ast.AST) -> list:
+    """Classify one axis-name expression into a JSON token:
+    ``["lit", v]`` / ``["name", n]`` (module const or import, resolved
+    at project tier) / ``["param", fn, p]`` / ``["unknown", src]``."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, str):
+            return ["lit", node.value]
+        return ["unknown", repr(node.value)]
+    if isinstance(node, ast.Name):
+        if node.id in consts:
+            return ["lit", consts[node.id]]
+        info = _enclosing_with_param(sf, node, node.id)
+        if info is not None:
+            return ["param", info.name, node.id]
+        return ["name", node.id]
+    try:
+        return ["unknown", ast.unparse(node)]
+    except Exception:
+        return ["unknown", "<expr>"]
+
+
+# Collectives whose axis name is the FIRST positional argument
+# (everything else takes (operand, axis_name, ...)).
+_AXIS_FIRST_ARG = {"axis_index"}
+
+
+def _collective_axis(sf, consts, node: ast.Call) -> list:
+    for kw in node.keywords:
+        if kw.arg in ("axis_name", "axes", "axis"):
+            return _axis_token(sf, consts, kw.value)
+    if _last(dotted(node.func)) in _AXIS_FIRST_ARG and node.args:
+        return _axis_token(sf, consts, node.args[0])
+    if len(node.args) >= 2:
+        return _axis_token(sf, consts, node.args[1])
+    return ["unknown", "<none>"]
+
+
+def _divergence_reason(sf: SourceFile, node: ast.AST) -> str | None:
+    """Why the collective at ``node`` may be issued by some shards/hosts
+    and not others: the R004 classifier applied to every enclosing
+    ``if``/``while`` up to the function boundary, plus ``try``."""
+    info = sf.enclosing_function(node)
+    boundary = info.node if info is not None else None
+    child = node
+    for anc in sf.ancestors(node):
+        if anc is boundary:
+            return None
+        if isinstance(anc, ast.Try):
+            return "inside a try block (an exception skips the " \
+                   "remaining collectives on that shard only)"
+        if isinstance(anc, (ast.If, ast.While)) and child is not anc.test:
+            why = _condition_is_divergent(anc.test)
+            if why:
+                return why
+        child = anc
+    return None
+
+
+def _spec_axis_tokens(sf, consts, expr: ast.AST) -> list:
+    """Axis tokens mentioned by an in_specs/out_specs expression: every
+    argument of every ``P(...)`` / ``PartitionSpec(...)`` call in it."""
+    toks = []
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call) \
+                and _last(dotted(node.func)) in ("P", "PartitionSpec"):
+            for a in node.args:
+                if isinstance(a, ast.Constant) and a.value is None:
+                    continue
+                toks.append(_axis_token(sf, consts, a))
+    return toks
+
+
+def _forwarded(expr: ast.AST) -> set:
+    """Callable names ``expr`` can forward (bare name / partial /
+    ternary) — the callgraph helper, reused so wrap-target semantics
+    cannot drift between tiers."""
+    from cuvite_tpu.analysis.callgraph import _forwarded_names
+
+    return _forwarded_names(expr)
+
+
+def _replicated_ok_lines(sf: SourceFile) -> dict:
+    """{lineno: reason} for every ``# graftlint: replicated-ok=`` pragma
+    (real comment tokens, same discipline as the disable pragmas)."""
+    out: dict = {}
+    for lineno, comment in sf._iter_comments():
+        if "replicated-ok" not in comment:
+            continue
+        m = _REPL_OK_RE.search(comment)
+        if m:
+            out[lineno] = m.group(1)
+    return out
+
+
+def mesh_summary(sf: SourceFile) -> dict:
+    """The JSON-serializable SPMD facts of one file (see module
+    docstring); rides the tier-2 summary under the ``"mesh"`` key."""
+    consts = _module_consts(sf)
+    repl_ok = _replicated_ok_lines(sf)
+    meshes: list = []
+    wraps: list = []
+    collectives: list = []
+    allocs: list = []
+    binds: list = []
+    params: dict = {}
+    for info in sf.functions:
+        params.setdefault(info.name, list(info.params))
+
+    # Local assignments forwarding callables (body = partial(f, ...)),
+    # scope-keyed like callgraph._entry_seed_names.
+    assign_map: dict = {}
+    for node in sf.walk():
+        if isinstance(node, ast.Assign):
+            fwd = _forwarded(node.value)
+            if fwd:
+                scope = sf.enclosing_function(node)
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        assign_map.setdefault(
+                            (id(scope), t.id), set()).update(fwd)
+
+    def expand_targets(node, names: set) -> list:
+        scope = sf.enclosing_function(node)
+        work = set(names)
+        for _ in range(4):
+            nxt = set()
+            for n in work:
+                nxt |= assign_map.get((id(scope), n), set())
+                nxt |= assign_map.get((id(None), n), set())
+            if nxt <= work:
+                break
+            work |= nxt
+        return sorted(work)
+
+    def record_wrap(node, fn_name, targets, spec_axes):
+        wraps.append({
+            "fn": fn_name,
+            "line": getattr(node, "lineno", 1),
+            "snippet": sf.line(getattr(node, "lineno", 1)),
+            "targets": targets,
+            "axes": spec_axes,
+        })
+
+    def size_symbol_of(expr: ast.AST) -> str | None:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and n.id in SIZE_SYMBOLS:
+                return n.id
+            if isinstance(n, ast.Attribute) and n.attr in SIZE_SYMBOLS:
+                return n.attr
+        return None
+
+    for node in sf.walk():
+        # shard_map decorator spellings on defs:
+        #   @shard_map(mesh=..., in_specs=...)   (the comm.mesh wrapper)
+        #   @functools.partial(shard_map, mesh=..., in_specs=...)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if not isinstance(dec, ast.Call):
+                    continue
+                fname = dotted(dec.func)
+                target_call = dec
+                if _last(fname) == "partial" and dec.args \
+                        and _last(dotted(dec.args[0])) == "shard_map":
+                    pass
+                elif _last(fname) == "shard_map":
+                    pass
+                else:
+                    continue
+                spec_axes = []
+                for kw in target_call.keywords:
+                    if kw.arg in ("in_specs", "out_specs"):
+                        spec_axes.extend(
+                            _spec_axis_tokens(sf, consts, kw.value))
+                info = sf.enclosing_function(node)
+                record_wrap(dec, info.name if info else "",
+                            [node.name], spec_axes)
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted(node.func)
+        last = _last(fname)
+        info = sf.enclosing_function(node)
+        fn_name = info.name if info is not None else ""
+        line = getattr(node, "lineno", 1)
+
+        # Mesh constructions: Mesh(devices, ('v',)) / jax.make_mesh.
+        if last in ("Mesh", "make_mesh") and len(node.args) >= 2:
+            axes = [_axis_token(sf, consts, el)
+                    for el in (node.args[1].elts
+                               if isinstance(node.args[1],
+                                             (ast.Tuple, ast.List))
+                               else [node.args[1]])]
+            meshes.append({"fn": fn_name, "line": line,
+                           "snippet": sf.line(line), "axes": axes})
+
+        # shard_map call-site wraps: shard_map(body, mesh=..., ...).
+        if last == "shard_map" and node.args:
+            spec_axes = []
+            for kw in node.keywords:
+                if kw.arg in ("in_specs", "out_specs"):
+                    spec_axes.extend(_spec_axis_tokens(sf, consts,
+                                                       kw.value))
+            targets = expand_targets(node, _forwarded(node.args[0]))
+            record_wrap(node, fn_name, targets, spec_axes)
+
+        # SPMD collectives with their axis argument.
+        if last in SPMD_COLLECTIVES and last not in COLLECTIVE_NAMES:
+            collectives.append({
+                "fn": fn_name, "call": fname or last, "line": line,
+                "snippet": sf.line(line),
+                "axis": _collective_axis(sf, consts, node),
+                "divergent": (_divergence_reason(sf, node)
+                              if last in _ORDERING_COLLECTIVES else None),
+            })
+            if last == "all_gather":
+                # all_gather materializes the gathered axis replicated
+                # per chip — an R025 site regardless of symbol names.
+                allocs.append({
+                    "fn": fn_name, "call": fname or last, "line": line,
+                    "snippet": sf.line(line), "size": "all_gather",
+                    "replicated_ok": repl_ok.get(line),
+                })
+
+        # O(nv_total) materializations (R025).
+        sym = None
+        if last in _ALLOC_CALLS and node.args:
+            # broadcast_to(arr, shape): the size lives in the SECOND
+            # positional; everything else takes the shape first.
+            shape_arg = node.args[1] \
+                if last == "broadcast_to" and len(node.args) >= 2 \
+                else node.args[0]
+            sym = size_symbol_of(shape_arg)
+        if sym is None and last.startswith(_SEGMENT_PREFIX):
+            for kw in node.keywords:
+                if kw.arg == "num_segments":
+                    sym = size_symbol_of(kw.value)
+            if sym is None and len(node.args) >= 3:
+                # num_segments spelled positionally:
+                # segment_sum(data, segment_ids, num_segments).
+                sym = size_symbol_of(node.args[2])
+        if sym is not None:
+            allocs.append({
+                "fn": fn_name, "call": fname or last, "line": line,
+                "snippet": sf.line(line), "size": sym,
+                "replicated_ok": repl_ok.get(line),
+            })
+
+        # Axis-relevant call-site bindings, for parameter resolution:
+        # keyword args whose name mentions axis, positional string
+        # literals, and positional Names that resolve to axis-ish
+        # tokens.  Bounded: nothing else is recorded.
+        bind_pos: dict = {}
+        bind_kw: dict = {}
+        for i, a in enumerate(node.args):
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                bind_pos[str(i)] = ["lit", a.value]
+            elif isinstance(a, ast.Name) \
+                    and ("axis" in a.id.lower() or a.id in consts):
+                bind_pos[str(i)] = _axis_token(sf, consts, a)
+        for kw in node.keywords:
+            if kw.arg and "axis" in kw.arg.lower():
+                bind_kw[kw.arg] = _axis_token(sf, consts, kw.value)
+        if (bind_pos or bind_kw) and fname:
+            binds.append({"fn": fn_name, "callee": fname,
+                          "pos": bind_pos, "kw": bind_kw})
+
+    return {
+        "version": MESH_SUMMARY_VERSION,
+        "consts": consts,
+        "params": params,
+        "meshes": meshes,
+        "shard_maps": wraps,
+        "collectives": collectives,
+        "allocs": allocs,
+        "binds": binds,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Project-tier linking.
+
+
+class MeshProject:
+    """Axis-resolution view over a linked summary set (wraps a
+    callgraph.Project; built once per run_project pass)."""
+
+    MAX_DEPTH = 5
+
+    def __init__(self, project):
+        self.project = project
+        self.mesh_of: dict = {}
+        for s in project.summaries:
+            self.mesh_of[s["module"]] = s.get("mesh") or {
+                "consts": {}, "params": {}, "meshes": [],
+                "shard_maps": [], "collectives": [], "allocs": [],
+                "binds": [],
+            }
+        # (module, funcname) -> [(caller module, bind dict)]
+        self.call_binds: dict = {}
+        for s in project.summaries:
+            mesh = self.mesh_of[s["module"]]
+            for b in mesh["binds"]:
+                tgt = project._resolve(s, b["callee"])
+                if tgt is None or tgt not in project.funcs:
+                    continue
+                self.call_binds.setdefault(tgt, []).append(
+                    (s["module"], b))
+
+    # -- axis-token resolution -----------------------------------------
+
+    def resolve_token(self, module: str, token, depth: int = None,
+                      seen=None) -> set:
+        """Literal axis strings a token can denote ({} = unresolved)."""
+        if depth is None:
+            depth = self.MAX_DEPTH
+        if not token or depth <= 0:
+            return set()
+        kind = token[0]
+        if kind == "lit":
+            return {token[1]}
+        if kind == "name":
+            # Module const (already folded at summarize time) or an
+            # imported constant: follow the from-import to its home
+            # module's consts.
+            summary = self.project.by_module.get(module)
+            if summary is None:
+                return set()
+            name = token[1]
+            fi = summary["from_imports"].get(name)
+            if fi:
+                home = self.mesh_of.get(fi[0])
+                if home and fi[1] in home["consts"]:
+                    return {home["consts"][fi[1]]}
+                # one-hop package re-export
+                pkg = self.project.by_module.get(fi[0])
+                if pkg and fi[1] in pkg["from_imports"]:
+                    m2, sym2 = pkg["from_imports"][fi[1]]
+                    home2 = self.mesh_of.get(m2)
+                    if home2 and sym2 in home2["consts"]:
+                        return {home2["consts"][sym2]}
+            return set()
+        if kind == "param":
+            fn, pname = token[1], token[2]
+            key = (module, fn, pname)
+            seen = seen or set()
+            if key in seen:
+                return set()
+            seen = seen | {key}
+            mesh = self.mesh_of.get(module, {})
+            plist = mesh.get("params", {}).get(fn, [])
+            out: set = set()
+            for (caller_mod, b) in self.call_binds.get((module, fn), ()):
+                tok = b["kw"].get(pname)
+                if tok is None and pname in plist:
+                    tok = b["pos"].get(str(plist.index(pname)))
+                if tok is not None:
+                    out |= self.resolve_token(caller_mod, tok,
+                                              depth - 1, seen)
+            return out
+        return set()
+
+    # -- mesh/wrap facts -----------------------------------------------
+
+    def known_mesh_axes(self) -> set:
+        axes: set = set()
+        for module, mesh in self.mesh_of.items():
+            for m in mesh["meshes"]:
+                for tok in m["axes"]:
+                    axes |= self.resolve_token(module, tok)
+        return axes
+
+    def wraps(self):
+        """Every shard_map wrap: (module, summary, wrap dict,
+        resolved-axis set or None when any token is unresolved)."""
+        out = []
+        for s in self.project.summaries:
+            mesh = self.mesh_of[s["module"]]
+            for w in mesh["shard_maps"]:
+                axes: set | None = set()
+                for tok in w["axes"]:
+                    r = self.resolve_token(s["module"], tok)
+                    if not r:
+                        axes = None  # partially symbolic: admit all
+                        break
+                    axes |= r
+                if axes is not None and not axes:
+                    axes = None  # no P() literals at all
+                out.append((s["module"], s, w, axes))
+        return out
+
+    def wrap_reach(self):
+        """One BFS from every shard_map wrap target: pred map for
+        chains, plus per-function admitted axis sets — the UNION over
+        every wrap that can reach the function (None = some reaching
+        wrap admits anything).  Admitted axes propagate to a fixpoint
+        over ALL call edges among reached functions, not just the BFS
+        tree: a helper reached from both the vertex-sharded and the
+        batch-sharded wrap must admit both axes, or a legitimate
+        collective would be falsely convicted."""
+        project = self.project
+        seeds = []
+        wrap_axes: dict = {}
+        for module, s, w, axes in self.wraps():
+            for t in w["targets"]:
+                tgt = project._resolve(s, t)
+                if tgt is not None and tgt in project.funcs:
+                    seeds.append(tgt)
+                    prev = wrap_axes.get(tgt, set())
+                    if axes is None or prev is None:
+                        wrap_axes[tgt] = None
+                    else:
+                        wrap_axes[tgt] = prev | axes
+        pred = project._reach(seeds)
+
+        def merge(a, b):
+            if a is None or b is None:
+                return None
+            return a | b
+
+        admitted: dict = {k: wrap_axes.get(k, set()) for k in pred}
+        changed = True
+        while changed:
+            changed = False
+            for key in pred:
+                src = admitted.get(key, set())
+                if src is not None and not src:
+                    continue  # nothing to propagate yet
+                for fn in project.funcs.get(key, ()):
+                    for tgt in project._edges_of(key[0], fn):
+                        if tgt not in pred:
+                            continue
+                        merged = merge(admitted.get(tgt, set()), src)
+                        if merged != admitted.get(tgt, set()):
+                            admitted[tgt] = merged
+                            changed = True
+        return pred, admitted
+
+
+def replicated_inventory(summaries) -> list:
+    """Every annotated O(nv_total) materialization in the summary set:
+    [{rel, line, fn, call, size, reason, snippet}] — the closed,
+    justified inventory of per-chip-replicated tables ROADMAP item 5
+    starts from (``python tools/mesh_audit.py --inventory`` prints
+    it)."""
+    out = []
+    for s in summaries:
+        mesh = (s or {}).get("mesh") or {}
+        for a in mesh.get("allocs", ()):
+            if a.get("replicated_ok"):
+                out.append({
+                    "rel": s["rel"], "line": a["line"], "fn": a["fn"],
+                    "call": a["call"], "size": a["size"],
+                    "reason": a["replicated_ok"],
+                    "snippet": a["snippet"],
+                })
+    return sorted(out, key=lambda d: (d["rel"], d["line"]))
+
+
+# ---------------------------------------------------------------------------
+# Rules.
+
+from cuvite_tpu.analysis.callgraph import ProjectRule  # noqa: E402
+
+
+def _mesh_view(project):
+    """One MeshProject + wrap-reach per project pass, shared by the
+    three rules (identical inputs -> identical outputs; rebuilding the
+    call-bind index and the reach fixpoint three times per lint run is
+    pure tax).  Cached on the Project instance, which lives exactly one
+    run_project pass."""
+    view = getattr(project, "_tier5_view", None)
+    if view is None:
+        mp = MeshProject(project)
+        view = (mp,) + mp.wrap_reach()
+        project._tier5_view = view
+    return view
+
+
+def _site_finding(rule, summary, site, message) -> Finding:
+    return Finding(rule=rule.id, severity=rule.severity,
+                   path=summary["rel"], line=site["line"],
+                   message=message, snippet=site["snippet"])
+
+
+@register
+class AxisNameDrift(ProjectRule):
+    id = "R023"
+    severity = "high"
+    title = "collective axis name is not an axis of the meshes whose " \
+            "shard_map reaches it (cross-module)"
+
+    def check_project(self, project):
+        mp, pred, admitted = _mesh_view(project)
+        known = mp.known_mesh_axes()
+        for summary in project.summaries:
+            mod = summary["module"]
+            mesh = mp.mesh_of[mod]
+            for c in mesh["collectives"]:
+                key = (mod, c["fn"])
+                if key not in pred:
+                    continue
+                axes = mp.resolve_token(mod, c["axis"])
+                if not axes:
+                    continue  # unresolved: bounded false negative
+                chain = project.chain(pred, key)
+                bad = sorted(axes - known) if known else []
+                if bad:
+                    yield _site_finding(
+                        self, summary, c,
+                        f"{c['call']}(...) uses axis "
+                        f"{', '.join(map(repr, bad))} which no mesh in "
+                        f"the project constructs (known axes: "
+                        f"{sorted(known)}); reached from a shard_map "
+                        f"body via {chain} — a renamed/split mesh axis "
+                        "leaves this collective deadlocking or crashing "
+                        "at trace time")
+                    continue
+                adm = admitted.get(key, None)
+                if adm is not None and adm and not (axes & adm):
+                    yield _site_finding(
+                        self, summary, c,
+                        f"{c['call']}(...) uses axis "
+                        f"{sorted(axes)} but every shard_map that "
+                        f"reaches it ({chain}) maps only axes "
+                        f"{sorted(adm)}: the collective would fail on "
+                        "the meshes that actually run this body (the "
+                        "two-level ICI/DCN split bug class)")
+
+
+@register
+class WholeProgramCollectiveDivergence(ProjectRule):
+    id = "R024"
+    severity = "high"
+    title = "SPMD collective under a data-dependent branch in code " \
+            "reachable from a shard_map body (cross-module)"
+
+    def check_project(self, project):
+        mp, pred, _admitted = _mesh_view(project)
+        for summary in project.summaries:
+            mod = summary["module"]
+            for c in mp.mesh_of[mod]["collectives"]:
+                if not c.get("divergent"):
+                    continue
+                key = (mod, c["fn"])
+                if key not in pred:
+                    continue
+                chain = project.chain(pred, key)
+                yield _site_finding(
+                    self, summary, c,
+                    f"collective {c['call']}(...) is issued under a "
+                    f"branch that can differ across shards/hosts "
+                    f"({c['divergent']}), and the function is reachable "
+                    f"from a shard_map body ({chain}): shards "
+                    "disagreeing on the collective sequence is the "
+                    "canonical SPMD deadlock (per-file host-wrapper "
+                    "cases stay R004's); issue the collective "
+                    "unconditionally or branch on a trace-time static")
+
+
+@register
+class ReplicationAudit(ProjectRule):
+    id = "R025"
+    severity = "high"
+    title = "O(nv_total)-per-chip buffer materialized in shard_map-" \
+            "reachable code without a replicated-ok justification"
+
+    def check_project(self, project):
+        mp, pred, _admitted = _mesh_view(project)
+        for summary in project.summaries:
+            mod = summary["module"]
+            for a in mp.mesh_of[mod]["allocs"]:
+                if a.get("replicated_ok"):
+                    continue
+                key = (mod, a["fn"])
+                if key not in pred:
+                    continue
+                chain = project.chain(pred, key)
+                what = ("all_gather replicates the gathered axis"
+                        if a["size"] == "all_gather"
+                        else f"size scales with {a['size']}")
+                yield _site_finding(
+                    self, summary, a,
+                    f"{a['call']}(...) materializes a device buffer "
+                    f"with no sharded axis inside shard_map-reachable "
+                    f"code ({chain}); {what}, i.e. O(nv_total) bytes "
+                    "PER CHIP — the exact class round-8 measured as "
+                    "the sparse-cutover wall.  Shard it, or justify "
+                    "with '# graftlint: replicated-ok=<reason>' on "
+                    "this line (the annotation feeds the closed "
+                    "replication inventory, tools/mesh_audit.py "
+                    "--inventory)")

@@ -783,7 +783,7 @@ def bucketed_modularity(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
         comm_full = env.comm_ext
     else:
         comm_full, gsum = seg.spmd_env(comm, axis_name)
-        comm_deg = gsum(seg.segment_sum(vdeg, comm, num_segments=nv_total))
+        comm_deg = gsum(seg.segment_sum(vdeg, comm, num_segments=nv_total))  # graftlint: replicated-ok=replicated-exchange mod pass; the sparse branch above avoids the table
     counter0 = jnp.zeros((nv_local,), dtype=wdt)
     hs, hd, hw = heavy_arrays
     ckey_h = jnp.take(comm_full, hd)
@@ -905,8 +905,8 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
         env = None
         comm_ref, gsum = seg.spmd_env(comm, axis_name)
         info = comm if info_comm is None else info_comm
-        comm_deg = gsum(seg.segment_sum(vdeg, info, num_segments=nv_total))
-        comm_size = gsum(seg.segment_sum(
+        comm_deg = gsum(seg.segment_sum(vdeg, info, num_segments=nv_total))  # graftlint: replicated-ok=replicated-exchange community degree table; sparse mode (the cutover fix) rides the ghost plan instead
+        comm_size = gsum(seg.segment_sum(  # graftlint: replicated-ok=replicated-exchange community size table; sparse mode attaches sizes to ghosts instead
             jnp.ones((nv_local,), dtype=vdt), info, num_segments=nv_total
         ))
         overflow = jnp.zeros((), dtype=bool)  # replicated: can't overflow

@@ -75,10 +75,10 @@ def louvain_step_local(
 
     # --- community info: size + weighted degree, recomputed fresh ---------
     comm_deg = gsum(
-        seg.segment_sum(vdeg_local, comm_local, num_segments=nv_total)
+        seg.segment_sum(vdeg_local, comm_local, num_segments=nv_total)  # graftlint: replicated-ok=replicated-exchange community degree table (sort engine has no sparse mode)
     )
     comm_size = gsum(
-        seg.segment_sum(
+        seg.segment_sum(  # graftlint: replicated-ok=replicated-exchange community size table (sort engine has no sparse mode)
             jnp.ones((nv_local,), dtype=vdt), comm_local, num_segments=nv_total
         )
     )

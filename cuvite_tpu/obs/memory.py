@@ -23,6 +23,29 @@ from __future__ import annotations
 import os
 
 
+def per_device_nbytes(a) -> int:
+    """The bytes ONE device holds for an array: the max over devices of
+    that device's addressable shard bytes.  A replicated placement
+    answers the full ``nbytes`` (every device holds a copy), an evenly
+    1-D-sharded one ``nbytes / n_devices`` — which is exactly the
+    number the tier-5 replication audit (analysis/meshcheck.py, M003)
+    grades against the declared scaling law.  Host arrays and anything
+    without sharding metadata count as replicated (conservative)."""
+    nb = int(getattr(a, "nbytes", 0) or 0)
+    try:
+        shards = a.addressable_shards
+    except Exception:
+        return nb
+    per: dict = {}
+    try:
+        for s in shards:
+            did = getattr(s.device, "id", s.device)
+            per[did] = per.get(did, 0) + int(s.data.nbytes)
+    except Exception:
+        return nb
+    return max(per.values()) if per else nb
+
+
 class DeviceMemoryLedger:
     """Per-category device-buffer byte accounting.
 
@@ -31,43 +54,66 @@ class DeviceMemoryLedger:
     nbytes of anything array-like (None and scalars are ignored);
     ``snapshot(phase)`` returns the live totals and folds them into the
     running per-category peaks (``peak_by_buffer``).
+
+    Two parallel books are kept per category: LOGICAL global bytes
+    (``arr.nbytes`` — what the driver asked for) and PER-DEVICE bytes
+    (:func:`per_device_nbytes` — what one chip actually holds, read off
+    the placement's sharding).  The per-device column is the tier-5
+    export: ``tools/mesh_audit.py`` grades it against the declared
+    scaling laws in ``tools/replication_budget.json`` (a "sharded"
+    category whose per-device bytes stop shrinking with the mesh is the
+    O(nv_total)-per-chip replication creep round-8 measured).
     """
 
     CATEGORIES = ("slab", "tables", "plans", "exchange", "scratch")
 
     def __init__(self):
         self.live: dict = {}
+        self.live_per_device: dict = {}
         self.peak_by_buffer: dict = {}
+        self.peak_per_device: dict = {}
         self.snapshots: list = []
 
     def begin_phase(self) -> None:
         self.live = {}
+        self.live_per_device = {}
 
     def track(self, category: str, *arrays) -> None:
         n = 0
+        nd = 0
         for a in arrays:
             if a is None:
                 continue
             nb = getattr(a, "nbytes", None)
             if nb:
                 n += int(nb)
+                nd += per_device_nbytes(a)
         if n:
             self.live[category] = self.live.get(category, 0) + n
+            self.live_per_device[category] = \
+                self.live_per_device.get(category, 0) + nd
 
     def track_nbytes(self, category: str, nbytes: int) -> None:
         if nbytes:
             self.live[category] = self.live.get(category, 0) + int(nbytes)
+            self.live_per_device[category] = \
+                self.live_per_device.get(category, 0) + int(nbytes)
 
     def snapshot(self, phase=None) -> dict:
         from cuvite_tpu.utils.trace import rss_high_water_mb
 
         by_buffer = dict(self.live)
+        per_device = dict(self.live_per_device)
         for k, v in by_buffer.items():
             if v > self.peak_by_buffer.get(k, 0):
                 self.peak_by_buffer[k] = v
+        for k, v in per_device.items():
+            if v > self.peak_per_device.get(k, 0):
+                self.peak_per_device[k] = v
         snap = {
             "phase": phase,
             "by_buffer": by_buffer,
+            "per_device": per_device,
             "total": sum(by_buffer.values()),
             "rss_mb": round(rss_high_water_mb(), 1),
         }

@@ -130,10 +130,15 @@ def ds_psum(pair, axis_name):
     """Exact cross-shard reduction of a scalar ds pair: all_gather the S
     per-shard pairs (S scalars — negligible traffic) and ds-tree-sum them.
     A plain psum of hi/lo parts would re-lose up to S*eps relative — the
-    very error the ds formulation removes."""
-    hi = jax.lax.all_gather(pair[0], axis_name)
-    lo = jax.lax.all_gather(pair[1], axis_name)
-    return ds_tree_sum(hi, lo)
+    very error the ds formulation removes.
+
+    Both channels ride ONE collective (hi/lo stacked [2]): the R025
+    replication audit surfaced this as two separate per-call all_gather
+    launches — on the hot ds32 modularity path that is one avoidable
+    collective launch per reduction.  Gathers are exact, so the packed
+    form is bit-identical to the two-launch one."""
+    both = jax.lax.all_gather(jnp.stack([pair[0], pair[1]]), axis_name)  # graftlint: replicated-ok=O(nshards) scalar ds pairs, not vertex-scaled
+    return ds_tree_sum(both[:, 0], both[:, 1])
 
 
 def ds_segment_sums_sorted(keys, vals, vals_lo=None):

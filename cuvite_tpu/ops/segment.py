@@ -53,7 +53,7 @@ def spmd_env(comm_local, axis_name):
     degenerates to identity."""
     if axis_name is None:
         return comm_local, lambda x: x
-    comm_full = jax.lax.all_gather(comm_local, axis_name, tiled=True)
+    comm_full = jax.lax.all_gather(comm_local, axis_name, tiled=True)  # graftlint: replicated-ok=the replicated exchange's community vector, O(nv_total) per chip by design; the sparse exchange (comm/exchange.py) is the fix past the cutover
     return comm_full, lambda x: jax.lax.psum(x, axis_name)
 
 

@@ -1387,6 +1387,288 @@ def test_r019_real_serve_package_self_lints_clean(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# Tier 5 (static): SPMD mesh/collective rules R023-R025.  Fixtures are
+# multi-file projects through run_project_sources, like tier 2's.
+
+MESH5_MESH = """
+import numpy as np
+from jax.sharding import Mesh
+
+VERTEX_AXIS = "v"
+BATCH_AXIS = "b"
+
+def make(devs):
+    return Mesh(np.array(devs), (VERTEX_AXIS,))
+
+def make_batch(devs):
+    return Mesh(np.array(devs), (BATCH_AXIS,))
+"""
+
+MESH5_STEP = """
+import jax
+from cuvite_tpu.fake_mesh5 import VERTEX_AXIS
+from cuvite_tpu.fake_helper5 import tail_sum
+
+def make_step(mesh):
+    def step(x, flag):
+        return tail_sum(x, VERTEX_AXIS, flag)
+    return jax.jit(shard_map(step, mesh=mesh, in_specs=P(VERTEX_AXIS),
+                             out_specs=P(VERTEX_AXIS)))
+"""
+
+
+def _mesh5_project(helper_src):
+    return {
+        "cuvite_tpu/fake_mesh5.py": MESH5_MESH,
+        "cuvite_tpu/fake_step5.py": MESH5_STEP,
+        "cuvite_tpu/fake_helper5.py": helper_src,
+    }
+
+
+MESH5_HELPER_DRIFT = """
+import jax
+
+def tail_sum(x, axis_name, flag):
+    return jax.lax.psum(x, "ici")
+"""
+
+MESH5_HELPER_WRONG_AXIS = """
+import jax
+
+def tail_sum(x, axis_name, flag):
+    return jax.lax.psum(x, "b")
+"""
+
+MESH5_HELPER_DIVERGENT = """
+import jax
+
+def tail_sum(x, axis_name, flag):
+    if flag.any():
+        return jax.lax.psum(x, axis_name)
+    return x
+"""
+
+MESH5_HELPER_CLEAN = """
+import jax
+
+def tail_sum(x, axis_name, flag):
+    return jax.lax.psum(x, axis_name)
+"""
+
+
+def test_r023_unknown_axis_cross_module():
+    findings = run_project_sources(_mesh5_project(MESH5_HELPER_DRIFT))
+    hits = [f for f in findings if f.rule == "R023"]
+    assert len(hits) == 1, findings
+    assert hits[0].path == "cuvite_tpu/fake_helper5.py"
+    assert "'ici'" in hits[0].message
+    assert "fake_step5.py::step" in hits[0].message  # the reach chain
+
+
+def test_r023_per_wrap_axis_mismatch():
+    # 'b' IS a constructed mesh axis, but every wrap reaching the
+    # helper maps only 'v': the two-level-split bug class.
+    findings = run_project_sources(
+        _mesh5_project(MESH5_HELPER_WRONG_AXIS))
+    hits = [f for f in findings if f.rule == "R023"]
+    assert len(hits) == 1, findings
+    assert "maps only axes ['v']" in hits[0].message
+
+
+def test_r023_multi_wrap_union_admits_both_axes():
+    """A helper reached from BOTH the vertex-sharded and the
+    batch-sharded wrap admits the union of their axes: psum over
+    either axis is legal, conviction requires disjointness from EVERY
+    reaching wrap (the fixpoint over all call edges, not the BFS
+    tree)."""
+    src = _mesh5_project(MESH5_HELPER_WRONG_AXIS)  # psum over 'b'
+    src["cuvite_tpu/fake_bstep5.py"] = """
+import jax
+from cuvite_tpu.fake_mesh5 import BATCH_AXIS
+from cuvite_tpu.fake_helper5 import tail_sum
+
+def make_bstep(mesh):
+    def bstep(x, flag):
+        return tail_sum(x, BATCH_AXIS, flag)
+    return jax.jit(shard_map(bstep, mesh=mesh, in_specs=P(BATCH_AXIS),
+                             out_specs=P(BATCH_AXIS)))
+"""
+    assert not any(f.rule == "R023"
+                   for f in run_project_sources(src))
+
+
+def test_r023_param_axis_resolves_clean():
+    # axis_name chases its call-site binding (VERTEX_AXIS -> "v")
+    # through the wrap: no finding.
+    findings = run_project_sources(_mesh5_project(MESH5_HELPER_CLEAN))
+    assert not any(f.rule in ("R023", "R024", "R025") for f in findings)
+
+
+def test_r023_no_wrap_no_finding():
+    src = _mesh5_project(MESH5_HELPER_DRIFT)
+    src["cuvite_tpu/fake_step5.py"] = MESH5_STEP.replace(
+        "shard_map(step, mesh=mesh, in_specs=P(VERTEX_AXIS),\n"
+        "                             out_specs=P(VERTEX_AXIS))", "step")
+    assert not any(f.rule == "R023"
+                   for f in run_project_sources(src))
+
+
+def test_r023_axis_index_first_positional_axis():
+    # axis_index takes the axis name as its FIRST argument (review
+    # regression: the axis-arg reader only looked at position 1).
+    findings = run_project_sources(_mesh5_project("""
+import jax
+
+def tail_sum(x, axis_name, flag):
+    me = jax.lax.axis_index("ici")
+    return x + me
+"""))
+    hits = [f for f in findings if f.rule == "R023"]
+    assert len(hits) == 1 and "'ici'" in hits[0].message
+
+
+def test_r023_inline_suppression():
+    src = _mesh5_project(MESH5_HELPER_DRIFT.replace(
+        'jax.lax.psum(x, "ici")',
+        'jax.lax.psum(x, "ici")  # graftlint: disable=R023 — staged axis'))
+    assert not any(f.rule == "R023" for f in run_project_sources(src))
+
+
+def test_r024_conditional_collective_cross_module():
+    findings = run_project_sources(
+        _mesh5_project(MESH5_HELPER_DIVERGENT))
+    hits = [f for f in findings if f.rule == "R024"]
+    assert len(hits) == 1, findings
+    assert hits[0].path == "cuvite_tpu/fake_helper5.py"
+    assert "flag.any" in hits[0].message
+    assert "fake_step5.py::step" in hits[0].message
+    # Unconditional collective in the same shape: clean (pinned above
+    # by test_r023_param_axis_resolves_clean).
+
+
+def test_r024_requires_shard_map_reach():
+    # The same divergent helper with NO shard_map anywhere: host-side
+    # code, R024 stays silent (R004 covers host collective wrappers).
+    src = {"cuvite_tpu/fake_solo5.py": MESH5_HELPER_DIVERGENT}
+    assert not any(f.rule == "R024" for f in run_project_sources(src))
+
+
+def test_r024_leaves_host_wrappers_to_r004():
+    src = _mesh5_project("""
+from cuvite_tpu.comm.multihost import gather_global
+
+def tail_sum(x, axis_name, flag):
+    if flag.any():
+        return gather_global(x)
+    return x
+""")
+    rules = {f.rule for f in run_project_sources(src)}
+    assert "R004" in rules and "R024" not in rules
+
+
+R025_TABLE = """
+import jax
+import jax.numpy as jnp
+
+def make_step(mesh, nv_total):
+    def step(vdeg, comm):
+        table = jnp.zeros((nv_total,), dtype=vdeg.dtype)%s
+        return jax.lax.psum(table, "v")
+    return jax.jit(shard_map(step, mesh=mesh, in_specs=P("v"),
+                             out_specs=P("v")))
+"""
+
+
+def test_r025_unannotated_nv_total_table():
+    src = {"cuvite_tpu/fake_r025.py": R025_TABLE % "",
+           "cuvite_tpu/fake_mesh5.py": MESH5_MESH}
+    hits = [f for f in run_project_sources(src) if f.rule == "R025"]
+    assert len(hits) == 1, hits
+    assert "nv_total" in hits[0].message
+    assert "replicated-ok" in hits[0].message
+
+
+def test_r025_replicated_ok_annotation_closes_the_finding():
+    src = {"cuvite_tpu/fake_r025.py": R025_TABLE
+           % "  # graftlint: replicated-ok=frozen community table",
+           "cuvite_tpu/fake_mesh5.py": MESH5_MESH}
+    assert not any(f.rule == "R025" for f in run_project_sources(src))
+    # ... and the annotated site lands in the closed inventory.
+    from cuvite_tpu.analysis.callgraph import summarize
+    from cuvite_tpu.analysis.engine import SourceFile
+    from cuvite_tpu.analysis.meshspec import replicated_inventory
+
+    rel = "cuvite_tpu/fake_r025.py"
+    inv = replicated_inventory(
+        [summarize(SourceFile(src[rel], path=rel, rel=rel))])
+    assert len(inv) == 1
+    assert inv[0]["reason"] == "frozen community table"
+
+
+def test_r025_positional_and_broadcast_spellings_convict():
+    """Review regressions: ``num_segments`` spelled POSITIONALLY
+    (segment_sum(data, ids, nv_total)) and ``broadcast_to`` (whose
+    shape is the SECOND positional) materialize the same O(nv_total)
+    table and must convict like the keyword/zeros spellings."""
+    src = {"cuvite_tpu/fake_r025pos.py": """
+import jax
+import jax.numpy as jnp
+
+def make_step(mesh, nv_total):
+    def step(vdeg, comm):
+        deg = seg.segment_sum(vdeg, comm, nv_total)
+        rep = jnp.broadcast_to(vdeg[:1], (nv_total,))
+        return jax.lax.psum(deg + rep, "v")
+    return jax.jit(shard_map(step, mesh=mesh, in_specs=P("v"),
+                             out_specs=P("v")))
+""",
+           "cuvite_tpu/fake_mesh5.py": MESH5_MESH}
+    hits = [f for f in run_project_sources(src) if f.rule == "R025"]
+    assert len(hits) == 2, hits
+
+
+def test_r025_unreached_table_is_clean():
+    # nv_total-sized table in plain host code (no shard_map reach):
+    # one copy on one device is not replication.
+    src = {"cuvite_tpu/fake_host25.py": """
+import jax.numpy as jnp
+
+def table_of(nv_total):
+    return jnp.zeros((nv_total,), dtype="int32")
+"""}
+    assert not any(f.rule == "R025" for f in run_project_sources(src))
+
+
+def test_tier5_rules_ride_the_cache_warm_equals_cold(tmp_path):
+    """R023 findings come from PROJECT-linked mesh facts riding the
+    tier-2 summaries: a warm (all-hits) run must reproduce them bit-
+    identically from the cache without reparsing."""
+    tree = tmp_path / "cuvite_tpu"
+    tree.mkdir()
+    (tree / "fake_mesh5.py").write_text(MESH5_MESH)
+    (tree / "fake_step5.py").write_text(MESH5_STEP)
+    (tree / "fake_helper5.py").write_text(MESH5_HELPER_DRIFT)
+    cache = str(tmp_path / "cache.json")
+    cold = run_paths([str(tree)], cache=cache)
+    warm = run_paths([str(tree)], cache=cache)
+    assert cold == warm
+    assert any(f.rule == "R023" for f in warm)
+
+
+def test_tier5_sarif_roundtrip():
+    from cuvite_tpu.analysis.__main__ import to_sarif
+
+    findings = run_project_sources(_mesh5_project(MESH5_HELPER_DRIFT))
+    doc = to_sarif([f for f in findings if f.rule == "R023"])
+    run = doc["runs"][0]
+    meta_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"R023", "R024", "R025"} <= meta_ids
+    assert [r["ruleId"] for r in run["results"]] == ["R023"]
+    assert run["results"][0]["level"] == "error"
+    assert run["results"][0]["partialFingerprints"]
+
+
+# ---------------------------------------------------------------------------
 # Incremental cache: hit == cold, bit for bit; edits invalidate.
 
 

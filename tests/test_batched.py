@@ -194,11 +194,17 @@ def test_one_device_sync_per_phase_batched(jobs, batch_result, monkeypatch):
 
 def test_sharding_never_changes_results(jobs, batch_result):
     """mesh=None (single-device program) and mesh='auto' (batch axis
-    sharded over the virtual-device mesh) produce identical tenants."""
-    unsharded = louvain_many(jobs, mesh=None)
-    for ra, rb in zip(batch_result.results, unsharded.results):
-        assert ra.modularity == rb.modularity
-        assert np.array_equal(ra.communities, rb.communities)
+    sharded over the virtual-device mesh) produce identical tenants —
+    asserted through the ONE shared meshcheck implementation of
+    "bit-identical across mesh shapes" (the tier-5 M002 check)."""
+    from cuvite_tpu.analysis.meshcheck import assert_mesh_neutral
+
+    def run(mesh):
+        br = batch_result if mesh == "auto" \
+            else louvain_many(jobs, mesh=mesh)
+        return [(r.communities, r.modularity) for r in br.results]
+
+    assert_mesh_neutral(run, ["auto", None], entry="batched_fused")
 
 
 def test_explicit_b_pad_validhalf(jobs):
@@ -393,8 +399,13 @@ def test_one_device_sync_per_phase_bucketed(jobs, bucketed_result,
 
 def test_bucketed_sharding_never_changes_results(jobs, bucketed_result):
     """The batch-axis mesh split changes which device runs which rows,
-    never what a bucketed row computes."""
-    unsharded = louvain_many(jobs, engine="bucketed", mesh=None)
-    for ra, rb in zip(bucketed_result.results, unsharded.results):
-        assert ra.modularity == rb.modularity
-        assert np.array_equal(ra.communities, rb.communities)
+    never what a bucketed row computes (the shared meshcheck M002
+    helper — one implementation across test files and the audit)."""
+    from cuvite_tpu.analysis.meshcheck import assert_mesh_neutral
+
+    def run(mesh):
+        br = bucketed_result if mesh == "auto" \
+            else louvain_many(jobs, engine="bucketed", mesh=mesh)
+        return [(r.communities, r.modularity) for r in br.results]
+
+    assert_mesh_neutral(run, ["auto", None], entry="batched_bucketed")

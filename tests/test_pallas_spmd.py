@@ -34,6 +34,8 @@ def rmat12():
 
 @pytest.mark.parametrize("exchange", ["replicated", "sparse"])
 def test_pallas_spmd_bit_identical_to_bucketed(rmat12, exchange):
+    from cuvite_tpu.analysis.meshcheck import assert_mesh_neutral
+
     ref = louvain_phases(rmat12, nshards=8, engine="bucketed",
                          exchange=exchange)
     with warnings.catch_warnings():
@@ -43,11 +45,14 @@ def test_pallas_spmd_bit_identical_to_bucketed(rmat12, exchange):
         warnings.simplefilter("error")
         res = louvain_phases(rmat12, nshards=8, engine="pallas",
                              exchange=exchange)
-    assert np.array_equal(res.communities, ref.communities), \
-        f"pallas-SPMD labels differ from bucketed-SPMD ({exchange})"
-    # Identical labels -> the per-phase precise recompute sees identical
-    # inputs -> exactly equal, not merely close.
-    assert res.modularity == ref.modularity
+    # Bit-identity via the ONE shared meshcheck implementation (tier-5
+    # M002): identical labels -> the per-phase precise recompute sees
+    # identical inputs -> Q exactly equal, not merely close.
+    by_engine = {"bucketed": ref, "pallas": res}
+    assert_mesh_neutral(
+        lambda eng: [(by_engine[eng].communities,
+                      by_engine[eng].modularity)],
+        ["bucketed", "pallas"], entry=f"pallas_spmd_{exchange}")
     # Coverage accounting rides the result: every rmat-12 degree class
     # fits the kernel ladder (<= PALLAS_MAX_WIDTH).
     assert res.pallas_coverage == 1.0

@@ -24,8 +24,32 @@
 #                                         # through (--scenario, --seed,
 #                                         # --format json).  Dynamic
 #                                         # results are never cached.
+#   tools/lint.sh --mesh-smoke            # tier-5 mesh-audit self-check:
+#                                         # the bucketed SPMD step (both
+#                                         # exchanges) at two fixed mesh
+#                                         # shapes — M001 collective
+#                                         # sequences, M002 label
+#                                         # neutrality, M003 replication
+#                                         # scaling vs tools/
+#                                         # replication_budget.json.
+#                                         # Extra args pass through
+#                                         # (--entries, --shapes,
+#                                         # --json).  Dynamic results
+#                                         # are never cached; the full
+#                                         # audit runs in tier-1 and as
+#                                         # ladder stage I.
 # See ANALYSIS.md for the rule catalogue and suppression/baseline flow.
 cd "$(dirname "$0")/.." || exit 2
+if [ "$1" = "--mesh-smoke" ]; then
+    shift
+    # mesh_audit.py pins the jax platform from CUVITE_PLATFORM (the
+    # axon plugin overrides a bare JAX_PLATFORMS env var, see
+    # tools/compile_audit.py) — honor an exported JAX_PLATFORMS by
+    # forwarding it into the knob the audit actually reads.
+    CUVITE_PLATFORM="${CUVITE_PLATFORM:-${JAX_PLATFORMS:-cpu}}"
+    export CUVITE_PLATFORM
+    exec python tools/mesh_audit.py --smoke "$@"
+fi
 if [ "$1" = "--sched-smoke" ]; then
     shift
     # Forced-CPU like tier-1: the harness stubs the batch runner, but

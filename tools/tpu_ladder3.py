@@ -21,8 +21,12 @@ valuable result first):
   then tools/heavy_ab.py (heavy-class kernel decision measurement),
   stage F (seg-coalesce fullrun A/B, ISSUE 8), stage G (batched
   multi-tenant serving at B in {1, 8, 64} — jobs/sec + pack_util,
-  ISSUE 9) and stage H (load generator vs the async daemon at
-  B in {8, 64} — on-chip SLO row + SIGTERM drain check, ISSUE 11).
+  ISSUE 9), stage H (load generator vs the async daemon at
+  B in {8, 64} — on-chip SLO row + SIGTERM drain check, ISSUE 11),
+  and stage I (tools/mesh_audit.py across the slice's pow2 mesh
+  shapes — the first on-chip M00x evidence: collective sequences,
+  cross-shape label bit-identity, per-chip HBM scaling laws;
+  ISSUE 15).
 
 Success marker: tools/TPU_LADDER3_DONE (platform!=cpu bench JSON
 landed).  Every result appends to tools/logs/tpu_ladder_r4.log immediately.
@@ -323,6 +327,43 @@ def stage_h():
                 f"json={last[-1] if last else out.stderr[-200:]}")
 
 
+def stage_i(platform, ndev):
+    """Mesh audit on the real chips (ISSUE 15): the first on-chip
+    M00x evidence.  tools/mesh_audit.py runs the sharded entries (both
+    exchanges + the batched engines) across the pow2 mesh shapes the
+    slice supports and grades M001 collective sequences, M002
+    cross-shape label bit-identity, and M003 per-device HBM scaling vs
+    tools/replication_budget.json — per-shape ledger rows checkpointed
+    as JSON the moment the audit returns.  On a multi-chip slice this
+    is the first time the scaling laws are measured against REAL
+    per-chip HBM placements instead of virtual host devices."""
+    shapes = [f"{s}x{ndev // s}" for s in (8, 4, 2)
+              if s <= ndev and ndev % s == 0]
+    # Cross-shape M001/M002 need >= 2 shapes: on a small slice add the
+    # unsharded 1xN factorization instead of silently grading nothing.
+    if len(shapes) < 2 and ndev > 1:
+        shapes.append(f"1x{ndev}")
+    shapes = shapes or ["1x1"]
+    note = "" if len(shapes) >= 2 else \
+        " (single shape: cross-shape M001/M002 NOT graded)"
+    out_path = os.path.join(REPO, "tools", "mesh_audit_tpu.json")
+    t0 = time.perf_counter()
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "mesh_audit.py"),
+             "--shapes", *shapes, "--out", out_path],
+            capture_output=True, text=True, timeout=1800, cwd=REPO,
+            env=dict(os.environ, CUVITE_PLATFORM=platform))
+    except subprocess.TimeoutExpired:
+        log("I: mesh_audit TIMEOUT (1800s)")
+        return
+    tail = out.stdout.strip().splitlines()
+    log(f"I: mesh_audit shapes={','.join(shapes)} rc={out.returncode} "
+        f"wall={time.perf_counter()-t0:.0f}s "
+        f"verdict={tail[-1] if tail else out.stderr[-200:]}{note} "
+        f"(json: {out_path})")
+
+
 def main():
     parts = probe()
     if parts is None:
@@ -395,6 +436,12 @@ def main():
         stage_h()
     except Exception as e:
         log(f"H: FAILED {type(e).__name__}: {e}")
+    # Stage I (ISSUE 15): the tier-5 mesh audit on real chips — first
+    # on-chip M00x evidence, per-shape ledger JSON checkpointed.
+    try:
+        stage_i(parts[0], int(parts[1]))
+    except Exception as e:
+        log(f"I: FAILED {type(e).__name__}: {e}")
     if got_tpu_json:
         with open(DONE, "w") as f:
             f.write(time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()) + "\n")
