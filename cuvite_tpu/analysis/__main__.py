@@ -46,6 +46,7 @@ from cuvite_tpu.analysis import callgraph as _cg       # noqa: F401
 from cuvite_tpu.analysis import lockset as _lockset    # noqa: F401
 from cuvite_tpu.analysis import lockorder as _lockord  # noqa: F401
 from cuvite_tpu.analysis import meshspec as _meshspec  # noqa: F401
+from cuvite_tpu.analysis import widthcheck as _widthcheck  # noqa: F401
 
 DEFAULT_PATHS = ["cuvite_tpu", "tools", "tests"]
 

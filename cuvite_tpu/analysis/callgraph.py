@@ -68,7 +68,7 @@ JIT_ENTRY_CALLS = set(_JIT_NAMES) | {
     "shard_map", "jax.experimental.shard_map.shard_map",
 }
 
-SUMMARY_VERSION = 6
+SUMMARY_VERSION = 7
 
 
 def module_of(rel: str) -> str:
@@ -269,7 +269,7 @@ def summarize(sf: SourceFile) -> dict:
     # R023-R025 mesh facts are rebuilt from cached summaries exactly
     # like R017/R018 are from the dataflow ones.  Lazy import: both
     # modules subclass ProjectRule from THIS module.
-    from cuvite_tpu.analysis import lockorder, meshspec
+    from cuvite_tpu.analysis import lockorder, meshspec, widthcheck
 
     return {
         "version": SUMMARY_VERSION,
@@ -281,6 +281,7 @@ def summarize(sf: SourceFile) -> dict:
         "functions": funcs,
         "locks": lockorder.lock_summary(sf),
         "mesh": meshspec.mesh_summary(sf),
+        "width": widthcheck.width_summary(sf),
         "suppress": {str(ln): sorted(ids)
                      for ln, ids in sf._line_suppress.items()},
         "file_suppress": sorted(sf._file_suppress),

@@ -83,6 +83,16 @@ assert 4096 * DEFAULT_C_TILE == ACC_BLOCK_ELEMS  # default class: no shrink
 # accumulator per step.
 DEFAULT_MAX_NV = 4096
 
+# Hard ceiling on the dense engines' vertex space: the flat (src, dst)
+# key is packed as (src << kbits) | dst in int32, so 2 * kbits must
+# stay <= 31 — nv_pad <= 2^15, a 2^30-slot flat domain.  This is the
+# same number _env_max_nv caps CUVITE_SEG_COALESCE_MAX_NV at, restated
+# as a fail-loud raise-guard so a caller bypassing coalesce_engine can
+# never wrap the packed key (widthcheck R026/R027 read it as the
+# eligibility predicate; tools/width_audit.py proves the one-past
+# class raises, W002).
+FLAT_NV_MAX = 1 << 15
+
 
 def _env_max_nv() -> int:
     from cuvite_tpu.utils.envknob import env_int
@@ -223,6 +233,12 @@ def seg_coalesce_xla(src, dst, w, *, nv_pad: int):
     compiles on every backend; on CPU this replaces the multi-second
     comparator sort with a linear pass)."""
     assert nv_pad & (nv_pad - 1) == 0, nv_pad  # flat packing needs pow2
+    if nv_pad > FLAT_NV_MAX:
+        raise ValueError(
+            f"seg_coalesce_xla: nv_pad = {nv_pad} over FLAT_NV_MAX = "
+            f"{FLAT_NV_MAX}: the int32 flat (src << kbits) | dst key "
+            "would overflow — coalesce_engine routes this class to "
+            "'sort'")
     kbits = (nv_pad - 1).bit_length()
     real = src < nv_pad
     flat = jnp.where(
@@ -254,7 +270,7 @@ def emit_coalesced(acc, cnt, *, ne_pad: int, src_dtype, dst_dtype):
     ne2 = jnp.sum(present.astype(jnp.int32))
     pos = jnp.cumsum(present.astype(jnp.int32)) - 1
     slot = jnp.where(present, pos, ne_pad)  # absent keys drop
-    idx = jnp.arange(nv_pad * nv_pad, dtype=jnp.int32)
+    idx = jnp.arange(nv_pad * nv_pad, dtype=jnp.int32)  # graftlint: width-ok=flat key domain is caller-gated to nv_pad <= FLAT_NV_MAX = 2^15 (coalesce_engine policy + the seg_coalesce_xla raise-guard), so nv_pad^2 <= 2^30 fits int32
     src2 = jnp.full((ne_pad,), nv_pad, src_dtype).at[slot].set(
         (idx >> kbits).astype(src_dtype), mode="drop")
     dst2 = jnp.zeros((ne_pad,), dst_dtype).at[slot].set(

@@ -134,7 +134,7 @@ def louvain_step_local(
     modularity = seg.modularity_terms(counter0, comm_deg, constant, gsum,
                                       accum_dtype, axis_name=axis_name)
 
-    n_moved = gsum(jnp.sum(move.astype(jnp.int32)))
+    n_moved = gsum(jnp.sum(move.astype(jnp.int32)))  # graftlint: width-ok=move is per-VERTEX (nv_pad <= 2^28 rows, sum <= 2^28 < 2^31); the slab-extent tag is argmax-index over-approximation, not a real edge-extent reduction
     return StepOut(target=target, modularity=modularity, n_moved=n_moved)
 
 

@@ -63,6 +63,17 @@ def spmd_env(comm_local, axis_name):
 # cuvite_tpu/ops/exactsum.py and driver.DS_MIN_TOTAL_WEIGHT).
 DS_ACCUM = "ds32"
 
+# Widest edge slab one device call may carry: the run-id/compaction
+# cumsums below count slab rows in int32, whose ceiling is 2^31 - 1 —
+# and a 2^30-row slab is already ~48 GB of operand HBM, past any single
+# chip.  Billion-edge graphs (Friendster's 3.6 B directed rows pad to
+# 2^32) MUST arrive pre-sharded into <= SLAB_NE_MAX slabs; the guard
+# fails loud instead of wrapping into wrong labels.  widthcheck (R026/
+# R028) reads this raise-guard as the eligibility predicate bounding
+# ne_pad, and tools/width_audit.py proves the one-past-boundary class
+# raises (W002).
+SLAB_NE_MAX = 1 << 30
+
 
 def modularity_terms(counter0, comm_deg, constant, gsum, accum_dtype,
                      axis_name=None):
@@ -188,6 +199,12 @@ def coalesced_runs(src, ckey, w, *, nv_pad, accum_dtype=None,
     kernels/seg_coalesce.py).  ds32 must use the sort engine.
     """
     ne_pad = src.shape[0]
+    if ne_pad > SLAB_NE_MAX:
+        raise ValueError(
+            f"coalesced_runs: slab has {ne_pad} rows, over SLAB_NE_MAX "
+            f"= {SLAB_NE_MAX}: the int32 run-id/compaction cumsums "
+            "would overflow (wrong labels, not a crash) — shard the "
+            "slab below the ceiling first")
     wdt = w.dtype
     if engine in ("pallas", "xla"):
         # The dense accumulators sum in the weight dtype only: a caller
@@ -260,6 +277,11 @@ def run_totals(w_s, starts):
     i to community c — the value the reference stores in ``counter``
     (/root/reference/louvain.cpp:2419-2427).
     """
+    ne_pad = w_s.shape[0]
+    if ne_pad > SLAB_NE_MAX:
+        raise ValueError(
+            f"run_totals: slab has {ne_pad} rows, over SLAB_NE_MAX = "
+            f"{SLAB_NE_MAX}: the int32 run-id cumsum would overflow")
     run_id = jnp.cumsum(starts.astype(jnp.int32)) - 1
     totals = segment_sum(w_s, run_id, num_segments=w_s.shape[0], sorted_ids=True)
     return jnp.take(totals, run_id), run_id

@@ -50,10 +50,27 @@ class Dataset:
     ground_truth_url: str | None = None
     synth_edges: int = 1 << 27  # offline stand-in size (directed records)
     bits64: bool = False
+    # Declared width envelope: the maximum vertex/directed-edge counts
+    # any slab built from this dataset may carry — what the width audit
+    # (analysis/widthcheck.py + tools/width_audit.py) derives its
+    # boundary shapes from.  Default to the published counts; a dataset
+    # whose pipeline renumbers/expands ids must declare the larger
+    # bound explicitly.
+    max_nv: int | None = None
+    max_ne: int | None = None
 
     @property
     def num_edges_directed(self) -> int:
         return 2 * self.num_edges_undirected
+
+    @property
+    def width_nv(self) -> int:
+        return self.max_nv if self.max_nv is not None else self.num_vertices
+
+    @property
+    def width_ne(self) -> int:
+        return self.max_ne if self.max_ne is not None \
+            else self.num_edges_directed
 
 
 DATASETS: dict = {
@@ -65,6 +82,8 @@ DATASETS: dict = {
             fmt="snap",
             num_vertices=3_072_441,
             num_edges_undirected=117_185_083,
+            max_nv=3_072_441,
+            max_ne=234_370_166,
             ground_truth_url="https://snap.stanford.edu/data/bigdata/"
                              "communities/com-orkut.all.cmty.txt.gz",
             synth_edges=1 << 27,
@@ -76,6 +95,8 @@ DATASETS: dict = {
             fmt="snap",
             num_vertices=65_608_366,
             num_edges_undirected=1_806_067_135,
+            max_nv=65_608_366,
+            max_ne=3_612_134_270,
             ground_truth_url="https://snap.stanford.edu/data/bigdata/"
                              "communities/com-friendster.all.cmty.txt.gz",
             synth_edges=1 << 27,
@@ -88,6 +109,8 @@ DATASETS: dict = {
             fmt="mtx",
             num_vertices=105_896_555,
             num_edges_undirected=3_738_733_648 // 2,
+            max_nv=105_896_555,
+            max_ne=3_738_733_648,
             synth_edges=1 << 27,
             bits64=True,
         ),
@@ -99,6 +122,78 @@ DATASETS: dict = {
 # self-loops): generous enough for bookkeeping drift, tight enough to
 # catch a truncated download or a broken converter.
 SIZE_ENVELOPE_REL = 0.02
+
+
+# ---------------------------------------------------------------------------
+# Declared width envelope (analysis/widthcheck.py + tools/width_audit.py
+# derive every boundary shape from HERE — the single source).
+
+# The synth/R-MAT scale ladder tops out at scale 28 (ROADMAP item 1's
+# billion-edge target): nv = 2^28, ne = EDGE_FACTOR * 2^28 = 2^32
+# directed records under the synth layout law below.
+RMAT_SCALE_MAX = 28
+# workloads/synth.SynthSpec's default mean directed degree (the layout
+# law is nv = max(64, edges // edge_factor), synth.py::_layout);
+# ``edges`` counts DIRECTED records, the repo's slab-row convention.
+EDGE_FACTOR = 16
+
+# Serving batch-ladder ceiling (== max(core.batch.BATCH_SIZES), pinned
+# by tier-1; restated here so the fetch module never imports the
+# device stack).
+BATCH_MAX = 64
+
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def rmat_scale_law(scale: int, edge_factor: int = EDGE_FACTOR) -> tuple:
+    """R-MAT/synth scale -> (nv, ne_directed): nv = 2^scale and
+    ne = edge_factor * 2^scale directed records — the inverse of the
+    synth layout law (nv = edges // edge_factor), so a scale-s stand-in
+    synthesized at this ne lands exactly on 2^s vertices."""
+    nv = 1 << scale
+    return nv, edge_factor * nv
+
+
+def synth_scale_law(edges: int, edge_factor: int = EDGE_FACTOR) -> tuple:
+    """Directed edge count -> (nv, ne_directed) under the synth layout
+    law (workloads/synth.py::_layout): nv = max(64, edges //
+    edge_factor)."""
+    return max(64, int(edges) // int(edge_factor)), int(edges)
+
+
+def max_workload() -> dict:
+    """The registry's declared max workload, in the width-symbol
+    vocabulary of analysis/widthcheck.py (which pins its stdlib-only
+    MAX_WORKLOAD copy against this dict in tier-1):
+
+    * ``nv_pad``/``nv_total`` — pow2 padding of the largest declared
+      vertex space (scale-28 R-MAT's 2^28 tops uk-2007's 105.9 M);
+    * ``ne_pad`` — pow2 padding of the largest declared directed edge
+      count (Friendster's 3.61 B and the scale-28 law's 2^32 both pad
+      to 2^32);
+    * ``two_m`` — total-weight ceiling, 2 * ne_pad (headroom for small
+      integer weights over the unit-weight mass);
+    * ``kbits``/``sbits`` — the packed-sort budget at that vertex space
+      (key_bound = nv_pad, src_bound = nv_pad + 1: ops/segment.py);
+    * ``B`` — the serving batch-ladder ceiling.
+    """
+    nv_max = max([d.width_nv for d in DATASETS.values()]
+                 + [rmat_scale_law(RMAT_SCALE_MAX)[0]])
+    ne_max = max([d.width_ne for d in DATASETS.values()]
+                 + [rmat_scale_law(RMAT_SCALE_MAX)[1]])
+    nv_pad = _next_pow2(nv_max)
+    ne_pad = _next_pow2(ne_max)
+    return {
+        "nv_pad": nv_pad,
+        "nv_total": nv_pad,
+        "ne_pad": ne_pad,
+        "two_m": 2 * ne_pad,
+        "kbits": max(nv_pad - 1, 1).bit_length(),
+        "sbits": max(nv_pad, 1).bit_length(),
+        "B": BATCH_MAX,
+    }
 
 
 def _verify_checksum(name: str, digest: str, expected: str | None,

@@ -371,3 +371,156 @@ def test_packed_sort_bound_violation_callback(bad, monkeypatch):
             jnp.asarray(src), jnp.asarray(ckey), jnp.asarray(w),
             src_bound=4, key_bound=4)
         jax.block_until_ready(out)
+
+# ---------------------------------------------------------------------------
+# ISSUE 16: the boundary trio generalized from the bare sort to the
+# coalesce CHOKEPOINT (coalesced_runs engine='sort' rides the packed
+# sort at src_bound = nv_pad + 1, key_bound = nv_pad, so nv_pad = 2^15
+# is the widest int32 packing and 2^16 the first ineligible width),
+# plus the heavy-layout elems budget and the tier-6 raise-guards.
+
+
+def _chokepoint_slab(nv_pad, ne_pad, seed):
+    """Slab with the extreme (nv_pad-1, nv_pad-1) packing duplicated so
+    coalescing must SUM across the widest key, dyadic weights (exact)."""
+    rng = np.random.default_rng(seed)
+    n_real = ne_pad - ne_pad // 7
+    src = np.full(ne_pad, nv_pad, np.int32)
+    dst = np.zeros(ne_pad, np.int32)
+    w = np.zeros(ne_pad, np.float32)
+    src[:n_real] = rng.integers(0, nv_pad, n_real)
+    dst[:n_real] = rng.integers(0, nv_pad, n_real)
+    src[:4] = [nv_pad - 1, nv_pad - 1, 0, 0]
+    dst[:4] = [nv_pad - 1, nv_pad - 1, nv_pad - 1, 0]
+    w[:n_real] = rng.integers(1, 64, n_real) / 8.0
+    return src, dst, w
+
+
+def _coalesce_oracle(src, ckey, w, nv_pad):
+    """Sorted-unique real (src, ckey) pairs with summed weights, in
+    float64 (the dyadic inputs make every f32 partial sum exact, so the
+    engine must match BIT-for-bit after the cast)."""
+    src, ckey, w = (np.asarray(x) for x in (src, ckey, w))
+    real = src < nv_pad
+    keys = src[real].astype(np.int64) * nv_pad + ckey[real]
+    order = np.argsort(keys, kind="stable")
+    ks, ws = keys[order], w[real][order].astype(np.float64)
+    uniq, start = np.unique(ks, return_index=True)
+    sums = np.add.reduceat(ws, start)
+    return ((uniq // nv_pad).astype(np.int32),
+            (uniq % nv_pad).astype(np.int32),
+            sums.astype(np.float32))
+
+
+def _assert_coalesce_matches_oracle(out, src, dst, w, nv_pad):
+    s_ref, c_ref, w_ref = _coalesce_oracle(src, dst, w, nv_pad)
+    src_c, ckey_c, w_c, n = (np.asarray(x) for x in jax.device_get(out))
+    n = int(n)
+    assert n == len(s_ref)
+    assert np.array_equal(src_c[:n], s_ref)
+    assert np.array_equal(ckey_c[:n], c_ref)
+    assert np.array_equal(w_c[:n], w_ref)
+    assert (src_c[n:] == nv_pad).all()
+
+
+def test_coalesce_chokepoint_widest_legal_31bit_packing():
+    """nv_pad = 2^15: sbits(nv_pad + 1) = 16 + kbits(nv_pad) = 15 == 31,
+    the widest int32 packing the chokepoint ever rides — the duplicated
+    (nv_pad-1, nv_pad-1) rows pack to the top key and must still
+    coalesce to ONE summed run, not sort to the front."""
+    nv_pad, ne_pad = 1 << 15, 8192
+    src, dst, w = _chokepoint_slab(nv_pad, ne_pad, seed=31)
+    out = coalesced_runs(jnp.asarray(src), jnp.asarray(dst),
+                         jnp.asarray(w), nv_pad=nv_pad, engine="sort")
+    _assert_coalesce_matches_oracle(out, src, dst, w, nv_pad)
+
+
+def test_coalesce_chokepoint_first_ineligible_width():
+    """nv_pad = 2^16: 17 + 16 == 33 bits — the chokepoint must take the
+    lexicographic fallback and still produce the exact coalesce."""
+    nv_pad, ne_pad = 1 << 16, 8192
+    src, dst, w = _chokepoint_slab(nv_pad, ne_pad, seed=32)
+    out = coalesced_runs(jnp.asarray(src), jnp.asarray(dst),
+                         jnp.asarray(w), nv_pad=nv_pad, engine="sort")
+    _assert_coalesce_matches_oracle(out, src, dst, w, nv_pad)
+
+
+def test_coalesce_chokepoint_forced_64_bit_identical():
+    """Under jax_enable_x64 the same ineligible width packs into ONE
+    int64 key — and the coalesced result must be bit-identical to the
+    lexicographic run (the packed/lex parity contract, at the
+    chokepoint rather than the bare sort)."""
+    nv_pad, ne_pad = 1 << 16, 8192
+    src, dst, w = _chokepoint_slab(nv_pad, ne_pad, seed=33)
+    arrs = tuple(jnp.asarray(x) for x in (src, dst, w))
+    base = jax.device_get(coalesced_runs(*arrs, nv_pad=nv_pad,
+                                         engine="sort"))
+    prior = jax.config.jax_enable_x64
+    try:
+        jax.config.update("jax_enable_x64", True)
+        forced = jax.device_get(coalesced_runs(*arrs, nv_pad=nv_pad,
+                                               engine="sort"))
+    finally:
+        jax.config.update("jax_enable_x64", prior)
+    for b, f, name in zip(base, forced, ("src", "ckey", "w", "n")):
+        assert np.array_equal(np.asarray(b), np.asarray(f)), name
+
+
+def test_slab_ne_max_raise_guard():
+    """The widest legal slab traces; one doubling past SLAB_NE_MAX
+    fails LOUD (the int32 run-id cumsums would wrap silently)."""
+    def probe(ne):
+        jax.eval_shape(
+            lambda s, c, w: coalesced_runs(s, c, w, nv_pad=1 << 12,
+                                           engine="sort"),
+            jax.ShapeDtypeStruct((ne,), jnp.int32),
+            jax.ShapeDtypeStruct((ne,), jnp.int32),
+            jax.ShapeDtypeStruct((ne,), jnp.float32))
+
+    probe(seg.SLAB_NE_MAX)
+    with pytest.raises(ValueError, match="SLAB_NE_MAX"):
+        probe(seg.SLAB_NE_MAX * 2)
+    with pytest.raises(ValueError, match="SLAB_NE_MAX"):
+        jax.eval_shape(
+            seg.run_totals,
+            jax.ShapeDtypeStruct((seg.SLAB_NE_MAX * 2,), jnp.float32),
+            jax.ShapeDtypeStruct((seg.SLAB_NE_MAX * 2,), jnp.bool_))
+
+
+def test_flat_nv_max_raise_guard():
+    """seg_coalesce_xla's flat (src << kbits) | dst key: FLAT_NV_MAX
+    traces, one doubling past raises (the key would wrap int32)."""
+    from cuvite_tpu.kernels.seg_coalesce import (FLAT_NV_MAX,
+                                                 seg_coalesce_xla)
+
+    def probe(nv):
+        jax.eval_shape(
+            lambda s, d, w: seg_coalesce_xla(s, d, w, nv_pad=nv),
+            jax.ShapeDtypeStruct((4096,), jnp.int32),
+            jax.ShapeDtypeStruct((4096,), jnp.int32),
+            jax.ShapeDtypeStruct((4096,), jnp.float32))
+
+    probe(FLAT_NV_MAX)
+    with pytest.raises(ValueError, match="FLAT_NV_MAX"):
+        probe(FLAT_NV_MAX * 2)
+
+
+def test_heavy_layout_elems_budget_boundary():
+    """build_heavy_layout's eligibility boundary: a layout landing
+    exactly ON max_elems is returned; one element past degrades to None
+    (the caller keeps the sorted path, with coverage accounting)."""
+    from cuvite_tpu.kernels.heavy_bincount import build_heavy_layout
+
+    nv_local = 16
+    src = np.repeat(np.arange(8, dtype=np.int32), 8)   # 8 hubs, deg 8
+    dst = np.tile(np.arange(8, dtype=np.int32), 8)
+    w = np.ones(64, np.float32)
+    # H = 8 -> Hp = 8; counts.max() = 8, d_chunk = 8 -> D = 8: 64 elems.
+    at = build_heavy_layout(src, dst, w, nv_local=nv_local,
+                            pad_id=nv_local, d_chunk=8, max_elems=64)
+    assert at is not None
+    verts, dstT, wT = at
+    assert verts.shape == (8,) and dstT.shape == (8, 8)
+    past = build_heavy_layout(src, dst, w, nv_local=nv_local,
+                              pad_id=nv_local, d_chunk=8, max_elems=63)
+    assert past is None

@@ -38,8 +38,32 @@
 #                                         # are never cached; the full
 #                                         # audit runs in tier-1 and as
 #                                         # ladder stage I.
+#   tools/lint.sh --width-smoke           # tier-6 width-audit self-check:
+#                                         # the packed-sort slab entries
+#                                         # traced at the scale-28 shard
+#                                         # shape (zero bytes allocated)
+#                                         # + every boundary probe —
+#                                         # W001 index-carrying buffer
+#                                         # widths, W002 fallback
+#                                         # selection at the bit edges,
+#                                         # W003 manifest drift vs
+#                                         # tools/width_budget.json.
+#                                         # Extra args pass through
+#                                         # (--entries, --workloads,
+#                                         # --json, --inventory).
+#                                         # Dynamic results are never
+#                                         # cached; the full audit runs
+#                                         # in tier-1 and as ladder
+#                                         # stage J.
 # See ANALYSIS.md for the rule catalogue and suppression/baseline flow.
 cd "$(dirname "$0")/.." || exit 2
+if [ "$1" = "--width-smoke" ]; then
+    shift
+    # Same platform-knob forwarding as --mesh-smoke below.
+    CUVITE_PLATFORM="${CUVITE_PLATFORM:-${JAX_PLATFORMS:-cpu}}"
+    export CUVITE_PLATFORM
+    exec python tools/width_audit.py --smoke "$@"
+fi
 if [ "$1" = "--mesh-smoke" ]; then
     shift
     # mesh_audit.py pins the jax platform from CUVITE_PLATFORM (the

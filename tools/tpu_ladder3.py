@@ -364,6 +364,35 @@ def stage_i(platform, ndev):
         f"(json: {out_path})")
 
 
+def stage_j(platform):
+    """Width audit on the real chips (ISSUE 16): the zero-allocation
+    scale-28 certification re-run against the TPU backend's own
+    lowering.  tools/width_audit.py traces the billion-edge-path
+    entries at the Friendster-class and scale-28 shard shapes (no
+    device bytes allocated — the trace is abstract even on chip) and
+    grades W001 index-carrying buffer widths, W002 fallback selection
+    at the bit-budget boundaries, and W003 manifest drift vs
+    tools/width_budget.json.  On-chip this certifies the width laws
+    against the REAL platform's dtype promotion and sort lowering, not
+    the CPU stand-in's."""
+    out_path = os.path.join(REPO, "tools", "width_audit_tpu.json")
+    t0 = time.perf_counter()
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "width_audit.py"),
+             "--out", out_path],
+            capture_output=True, text=True, timeout=1800, cwd=REPO,
+            env=dict(os.environ, CUVITE_PLATFORM=platform))
+    except subprocess.TimeoutExpired:
+        log("J: width_audit TIMEOUT (1800s)")
+        return
+    tail = out.stdout.strip().splitlines()
+    log(f"J: width_audit rc={out.returncode} "
+        f"wall={time.perf_counter()-t0:.0f}s "
+        f"verdict={tail[-1] if tail else out.stderr[-200:]} "
+        f"(json: {out_path})")
+
+
 def main():
     parts = probe()
     if parts is None:
@@ -442,6 +471,12 @@ def main():
         stage_i(parts[0], int(parts[1]))
     except Exception as e:
         log(f"I: FAILED {type(e).__name__}: {e}")
+    # Stage J (ISSUE 16): the tier-6 width audit on real chips — the
+    # scale-28 certification against the TPU's own lowering.
+    try:
+        stage_j(parts[0])
+    except Exception as e:
+        log(f"J: FAILED {type(e).__name__}: {e}")
     if got_tpu_json:
         with open(DONE, "w") as f:
             f.write(time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()) + "\n")
