@@ -507,6 +507,59 @@ def _stub_runner(graphs, **kw):
     return types.SimpleNamespace(results=results, n_phases=1)
 
 
+class _StubStreamSession:
+    """jax-free StreamSession twin for the streaming scenarios: the
+    daemon's ``delta`` verb and the StreamPool's LRU/ledger machinery
+    run for real; only the device work (slab upload, chokepoint apply,
+    re-cluster) is stubbed — the same seam LouvainServer's injected
+    ``runner`` gives the batch scenarios."""
+
+    def __init__(self, graph, tracer=None):
+        import numpy as np
+
+        self.nv = graph.num_vertices
+        self.ne = graph.num_edges
+        self.frontier_frac = 0.0
+        self._labels = None
+        self._np = np
+
+    def hbm_bytes(self) -> int:
+        return 1000
+
+    def labels(self):
+        return self._labels
+
+    def apply_delta(self, batch):
+        self.ne = self.ne + batch.n_ins - batch.n_del
+        self.frontier_frac = 0.25
+        return {"n_ins": batch.n_ins, "n_del": batch.n_del,
+                "n_del_hit": batch.n_del, "ne": self.ne,
+                "frontier_frac": 0.25, "wall_s": 0.0}
+
+    def recluster(self, warm="labels", **kw):
+        self._labels = self._np.zeros(self.nv, dtype=self._np.int64)
+        return types.SimpleNamespace(
+            modularity=0.5, num_communities=1, phases=[1],
+            total_iterations=2, communities=self._labels)
+
+
+def _delta_reqs(n: int, tenant: str, nv: int = 6) -> list:
+    """A tenant's delta stream: every request carries the graph spec
+    (so an LRU-evicted session transparently re-admits — maximizing
+    admit/evict interleavings under a tight budget), the last one also
+    re-clusters."""
+    reqs = []
+    for i in range(n):
+        req = {"op": "delta", "tenant": tenant,
+               "graph": {"nv": nv, "src": [0, 1, 2], "dst": [1, 2, 3]},
+               "ins": [[i % nv, (i + 2) % nv, 1.0]],
+               "del": []}
+        if i == n - 1:
+            req["recluster"] = True
+        reqs.append(req)
+    return reqs
+
+
 def _graph_reqs(n_jobs: int, tenant: str, *, with_ids: bool = False,
                 nv: int = 6, ne: int = 8) -> list:
     import numpy as np
@@ -578,7 +631,9 @@ class DaemonScenario:
                  drain_after_s: float = 0.03, with_ids: bool = False,
                  b_max: int = 2, linger_s: float = 0.02,
                  max_retries: int = 2, retry_base_s: float = 0.05,
-                 pipelined: bool = False, pack_hold_s: float = 0.0):
+                 pipelined: bool = False, pack_hold_s: float = 0.0,
+                 delta_tenants: int = 0, deltas_each: int = 0,
+                 stream_budget_bytes: int | None = None):
         self.name = name
         self.n_intake = n_intake
         self.jobs_each = jobs_each
@@ -592,6 +647,13 @@ class DaemonScenario:
         self.retry_base_s = retry_base_s
         self.pipelined = pipelined
         self.pack_hold_s = pack_hold_s
+        # Streaming arm (ISSUE 17): delta_tenants reader threads each
+        # driving deltas_each `delta` requests through the REAL
+        # _handle_delta/StreamPool path with stub sessions; a tight
+        # stream_budget_bytes forces LRU evictions mid-schedule.
+        self.delta_tenants = delta_tenants
+        self.deltas_each = deltas_each
+        self.stream_budget_bytes = stream_budget_bytes
         self.inventory = None   # filled by explore()/run_schedule()
 
     def setup(self, sched) -> dict:
@@ -602,15 +664,20 @@ class DaemonScenario:
         server = LouvainServer(
             ServeConfig(b_max=self.b_max, linger_s=self.linger_s,
                         engine="fused", max_retries=self.max_retries,
-                        retry_base_s=self.retry_base_s),
+                        retry_base_s=self.retry_base_s,
+                        stream_budget_bytes=(self.stream_budget_bytes
+                                             or 256 << 20)),
             clock=sched.clock, sleep=sched.sleep,
             faults=FaultPlan.parse(self.fault_plan),
-            runner=_stub_runner)
+            runner=_stub_runner,
+            stream_factory=(_StubStreamSession if self.deltas_each
+                            else None))
         daemon = ServeDaemon(server, sock_path="<concheck>",
                              poll_s=0.01, pipelined=self.pipelined)
         for attr in ("_wake", "_drain_req", "_done"):
             getattr(daemon, attr).name = f"ServeDaemon.{attr}"
         daemon.lock.name = "ServeDaemon.lock"
+        server.streams.lock.name = "StreamPool.lock"
         if self.pack_hold_s:
             # The hold runs on the server's (scheduler) sleep: a
             # schedule point inside the pack window, BEFORE the real
@@ -625,15 +692,21 @@ class DaemonScenario:
         if self.variant is not None:
             daemon._route_results = types.MethodType(self.variant, daemon)
         inventory = self.inventory or serve_inventory()
-        instrument(sched, [daemon, server, server.stats], inventory)
+        instrument(sched, [daemon, server, server.stats, server.streams],
+                   inventory)
         clients = [FakeClient(sched, i) for i in range(self.n_intake)]
         acks: dict = {}
+        delta_resps: list = []
 
         def intake(client, reqs):
             for req in reqs:
                 resp = daemon.handle(req, client)
                 if resp.get("ok") and "job_id" in resp:
                     acks[resp["job_id"]] = client
+
+        def delta_intake(client, reqs):
+            for req in reqs:
+                delta_resps.append(daemon.handle(req, client))
 
         def poller():
             for _ in range(2):
@@ -656,10 +729,13 @@ class DaemonScenario:
             sched.spawn(intake, name=f"intake{i}", args=(
                 client, _graph_reqs(self.jobs_each, f"t{i}",
                                     with_ids=self.with_ids)))
+        for t in range(self.delta_tenants):
+            sched.spawn(delta_intake, name=f"delta{t}", args=(
+                clients[0], _delta_reqs(self.deltas_each, f"d{t}")))
         sched.spawn(poller, name="poller")
         sched.spawn(drainer, name="drainer")
         return {"daemon": daemon, "server": server, "clients": clients,
-                "acks": acks}
+                "acks": acks, "delta_resps": delta_resps}
 
     def check(self, sched, ctx) -> None:
         daemon, server = ctx["daemon"], ctx["server"]
@@ -689,6 +765,30 @@ class DaemonScenario:
                 sched.record_failure(
                     "phantom-result",
                     f"terminal report for never-acked job {job_id}")
+        if self.deltas_each:
+            # ISSUE 17 — tenant deltas racing drain + LRU eviction:
+            # every delta request terminates exactly once (a dict reply,
+            # ok or a loud refusal — never dropped, never doubled), the
+            # stream pool's byte ledger conserves, and _finalize cleared
+            # all residency.
+            want = self.delta_tenants * self.deltas_each
+            resps = ctx["delta_resps"]
+            if len(resps) != want or not all(
+                    isinstance(r, dict) for r in resps):
+                sched.record_failure(
+                    "delta-exactly-once",
+                    f"{len(resps)}/{want} delta replies "
+                    f"(non-dict: {sum(not isinstance(r, dict) for r in resps)})")
+            scons = server.streams.conservation()
+            if not scons["ok"]:
+                sched.record_failure(
+                    "stream-conservation",
+                    f"stream pool ledger broken after drain: {scons}")
+            elif scons["resident"] != 0:
+                sched.record_failure(
+                    "stream-residency",
+                    f"{scons['resident']} sessions survived _finalize "
+                    "(pool.clear() missed them)")
 
 
 # ---------------------------------------------------------------------------
@@ -842,6 +942,15 @@ def builtin_scenarios() -> dict:
             "drain-vs-inflight-pack", n_intake=1, jobs_each=2,
             pipelined=True, pack_hold_s=0.05, drain_after_s=0.02,
             linger_s=0.01), "clean"),
+        # ISSUE 17 — tenant `delta` requests racing the daemon drain AND
+        # LRU eviction: the 1500-byte budget vs 1000-byte stub sessions
+        # forces admit/evict churn between the two tenants while the
+        # drainer pulls the rug.  Every delta terminates exactly once
+        # with the stream ledger conserved.
+        "delta-vs-drain": (lambda: DaemonScenario(
+            "delta-vs-drain", n_intake=1, jobs_each=1, delta_tenants=2,
+            deltas_each=3, stream_budget_bytes=1500,
+            drain_after_s=0.02), "clean"),
         "racy-routes": (lambda: DaemonScenario(
             "racy-routes", variant=_racy_route_results), "detect"),
         "send-under-lock": (lambda: DaemonScenario(

@@ -1,5 +1,6 @@
-"""graftlint per-file rule set R001..R016 + R022 (see ANALYSIS.md for
-the catalogue; R017-R021 live in the project-tier modules).
+"""graftlint per-file rule set R001..R016 + R022 + R029 (see
+ANALYSIS.md for the catalogue; R017-R021 live in the project-tier
+modules).
 
 Each rule targets a hazard class this codebase has actually hit (or is
 one refactor away from hitting): host syncs inside jitted code, jit
@@ -14,8 +15,10 @@ bench timing windows that close without forcing device completion,
 full-slab sorts in coarsen/kernels outside the sanctioned coalesce
 fallback chokepoint, compile/upload-per-job traps in serving queue
 loops, bucket-plan construction inside serve/ dispatch loops (planning
-belongs at pack time), and direct wall-clock reads in serve/ outside
-the injectable-clock plumbing (untestable deadlines).
+belongs at pack time), direct wall-clock reads in serve/ outside
+the injectable-clock plumbing (untestable deadlines), and resident-slab
+mutation in stream//serve/ outside the apply_delta_slab chokepoint
+(the donor-buffer aliasing trap).
 
 Rules are heuristic by design: they trade completeness for a near-zero
 false-positive rate on idiomatic code, and every remaining intentional
@@ -1192,3 +1195,90 @@ class ServeWallClockOutsidePlumbing(Rule):
                     "allowlisted, and a reference like "
                     "clock=time.monotonic as a DEFAULT is fine — only "
                     "direct calls are flagged)")
+
+
+# ---------------------------------------------------------------------------
+# R029: resident-slab mutation outside the apply_delta_slab chokepoint
+# (ISSUE 17).  A StreamSession keeps its slab (src/dst/w) RESIDENT on
+# device between delta batches, and the serving pool hands the same
+# arrays to every subsequent request — so those buffers are live
+# references, not scratch.  The streaming contract routes every edit
+# through ONE jitted chokepoint, stream/delta.py::apply_delta_slab
+# (sentinel-retire + masked append + re-coalesce, pow2 class
+# preserved), with grow_slab/shrink_slab as the only sanctioned class
+# reshapes.  An ``x.at[...].set(...)`` written directly in stream/ or
+# serve/ re-edits the slab OUTSIDE that seam: it silently forks the
+# canonical form the bit-equality tests pin (ordering, padding
+# sentinels, the 2m fixup), and under donation
+# (``jit(..., donate_argnums=...)``) it is the donor-buffer aliasing
+# trap outright — the resident reference the pool still holds now
+# points at a donated (invalidated) buffer, which jax surfaces as a
+# delete-buffer error only on the NEXT request that touches the
+# tenant.  Both spellings are flagged; delta.py itself (the chokepoint)
+# is exempt by path.
+
+_STREAM_SLAB_SCOPE = (
+    "cuvite_tpu/stream/",
+    "cuvite_tpu/serve/",
+)
+_STREAM_SLAB_CHOKEPOINT = "cuvite_tpu/stream/delta.py"
+# .at[...] update methods (jax.numpy.ndarray.at): every one writes.
+_AT_UPDATE_METHODS = {
+    "set", "add", "subtract", "multiply", "mul", "divide", "div",
+    "power", "min", "max", "apply",
+}
+
+
+def _is_at_indexed_update(node: ast.Call) -> bool:
+    """Matches ``<expr>.at[<idx>].<method>(...)`` — the functional
+    index-update spelling, which on a RESIDENT buffer is still a slab
+    edit even though it returns a copy."""
+    f = node.func
+    return (isinstance(f, ast.Attribute)
+            and f.attr in _AT_UPDATE_METHODS
+            and isinstance(f.value, ast.Subscript)
+            and isinstance(f.value.value, ast.Attribute)
+            and f.value.value.attr == "at")
+
+
+@register
+class ResidentSlabMutationOutsideChokepoint(Rule):
+    id = "R029"
+    severity = "high"
+    title = "resident-slab mutation in stream//serve/ outside the " \
+            "apply_delta_slab chokepoint (donor-buffer aliasing trap)"
+
+    def check(self, sf):
+        if not sf.rel.startswith(_STREAM_SLAB_SCOPE) \
+                or sf.rel == _STREAM_SLAB_CHOKEPOINT:
+            return
+        for node in sf.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_at_indexed_update(node):
+                yield self.finding(
+                    sf, node,
+                    f".at[...].{node.func.attr}() in a stream//serve/ "
+                    "module: resident slabs are edited ONLY through "
+                    "stream/delta.py::apply_delta_slab (sentinel-retire "
+                    "+ masked append + re-coalesce, one jitted "
+                    "chokepoint) so the canonical form the delta-vs-"
+                    "rebuild bit-equality tests pin cannot fork; route "
+                    "the edit through the chokepoint, or justify a "
+                    "genuinely non-slab update with an inline "
+                    "'# graftlint: disable=R029'")
+                continue
+            fname = dotted(node.func)
+            if fname in _JIT_NAMES:
+                for kw in node.keywords:
+                    if kw.arg in ("donate_argnums", "donate_argnames"):
+                        yield self.finding(
+                            sf, kw.value,
+                            f"jit({kw.arg}=...) in a stream//serve/ "
+                            "module: donating a RESIDENT buffer "
+                            "invalidates the reference the stream pool "
+                            "still holds — the next request on the "
+                            "tenant reads a deleted buffer; resident "
+                            "slabs flow through apply_delta_slab "
+                            "without donation, or justify with an "
+                            "inline '# graftlint: disable=R029'")

@@ -199,6 +199,27 @@ def shrink_slab(src, dst, w, *, new_nv_pad: int, new_ne_pad: int):
     return s, dst[:new_ne_pad], w[:new_ne_pad]
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("nv_pad", "new_nv_pad", "new_ne_pad"))
+def grow_slab(src, dst, w, *, nv_pad: int, new_nv_pad: int,
+              new_ne_pad: int):
+    """Lift a canonical slab to a LARGER pow2 class — the spill twin of
+    :func:`shrink_slab`, device ops only (a sentinel rewrite plus a
+    sentinel-padded extend).  The streaming delta path (stream/delta.py)
+    uses it when an insert batch overflows the resident class's padding
+    headroom; real rows keep their prefix order, so the grown slab is
+    still canonical."""
+    cur_ne_pad = src.shape[0]  # static under jit
+    if new_nv_pad < nv_pad or new_ne_pad < cur_ne_pad:
+        raise ValueError("grow_slab grows classes; use shrink_slab to drop")
+    pad_n = new_ne_pad - cur_ne_pad
+    s = jnp.where(src >= nv_pad, jnp.asarray(new_nv_pad, src.dtype), src)
+    s = jnp.concatenate([s, jnp.full((pad_n,), new_nv_pad, src.dtype)])
+    d = jnp.concatenate([dst, jnp.zeros((pad_n,), dst.dtype)])
+    ww = jnp.concatenate([w, jnp.zeros((pad_n,), w.dtype)])
+    return s, d, ww
+
+
 def maybe_shrink_to_class(src, dst, w, *, nc: int, ne2: int, nv_pad: int,
                           ne_pad: int, min_nv_pad: int = 4096,
                           min_ne_pad: int = 16384):

@@ -386,6 +386,26 @@ def _run_phase_loop_et(extra, comm0, threshold, lower, active0, et_delta,
     return past, prev_mod, iters, ovf, conv
 
 
+def warm_start_phase(extra, comm0, threshold, active0, *, call,
+                     max_iters=MAX_TOTAL_ITERATIONS, nv_real):
+    """Public seam for streaming warm starts (stream/session.py, ISSUE
+    17): one on-device ET phase loop (mode-1 freeze semantics) whose
+    phase-0 labels and active set come from the CALLER — the previous
+    run's composed labels and the delta frontier — instead of identity
+    and "all".  Phase semantics are exactly :func:`_run_phase_loop_et`:
+    a warm assignment whose first improvement sweep gains less than
+    ``threshold`` is returned unchanged (the last assignment whose gain
+    passed), so a no-op delta re-cluster keeps the warm labels bit-for-
+    bit.  Returns ``(labels, modularity, iterations, overflow, conv)``.
+    """
+    wdt = extra[2].dtype
+    lower = jnp.asarray(-1.0, dtype=wdt)
+    return _run_phase_loop_et(
+        extra, comm0, jnp.asarray(threshold, dtype=wdt), lower, active0,
+        jnp.asarray(0.25, dtype=wdt), call=call, max_iters=max_iters,
+        et_mode=1, nv_real=nv_real)
+
+
 def _phase_sync(labels, *rest):
     """THE per-phase device->host sync chokepoint: labels + the scalar/
     telemetry pytree come back in ONE transfer (a single jax.device_get

@@ -123,6 +123,10 @@ def _build_parser() -> argparse.ArgumentParser:
                     help="TCP port for intake (0 = ephemeral; mutually "
                          "exclusive with --socket)")
     dm.add_argument("--host", default="127.0.0.1")
+    dm.add_argument("--stream-budget-mb", type=float, default=256.0,
+                    help="HBM byte budget for resident StreamSessions "
+                         "(the `delta` verb's per-tenant live slabs; "
+                         "LRU-evicted past the budget — ISSUE 17)")
     return p
 
 
@@ -140,7 +144,9 @@ def _make_server(args):
         threshold=args.threshold, engine=args.engine,
         admission=admission, max_retries=args.max_retries,
         retry_base_s=args.retry_base_ms / 1e3,
-        autotune_b_max=bool(getattr(args, "autotune_b_max", False)))
+        autotune_b_max=bool(getattr(args, "autotune_b_max", False)),
+        stream_budget_bytes=int(
+            getattr(args, "stream_budget_mb", 256.0) * (1 << 20)))
     return config, faults, LouvainServer
 
 

@@ -41,6 +41,24 @@ Wire protocol (one JSON object per line, both directions)::
         "phases": 2, "iterations": 11}}
     <- {"failed": {"job_id": "job-3", "error": "..."}}
     <- {"shed": {"job_id": "job-4", "late_s": 0.12}}
+    -> {"op": "delta", "tenant": "t0", "synth": {"edges": 4096,
+        "seed": 7}, "ins": [[0, 9, 2.0]], "del": [[1, 2]],
+        "recluster": true, "warm": "labels"}
+    <- {"ok": true, "tenant": "t0", "resident": false, "delta":
+        {"n_ins": 2, "n_del": 2, "n_del_hit": 2, "ne": 4101,
+         "frontier_frac": 0.004}, "recluster": {"warm": "cold",
+         "q": 0.69, "communities": 11, "phases": 3, "iterations": 14}}
+
+The ``delta`` verb (ISSUE 17) mutates the tenant's RESIDENT device
+slab through the stream/ chokepoint and answers synchronously on the
+reader thread.  A graph spec is required on first contact (the one
+full upload); afterwards the session stays resident in the server's
+StreamPool (LRU under ``ServeConfig.stream_budget_bytes``) and each
+visit pays only its delta.  ``"recluster": true`` re-clusters in the
+same request — ``warm`` picks the seed (``labels``: previous labels +
+delta-frontier active set; ``plp``: label-propagation prepass;
+``cold``: identity), and the reply records which arm actually ran (a
+fresh session downgrades ``labels`` to ``cold``, visibly).
 
 Graph specs: inline ``graph`` (nv/src/dst/optional w), ``file`` (a
 Vite binary path readable by the daemon), or ``synth`` (the
@@ -317,10 +335,97 @@ class ServeDaemon:
                 return {"ok": True, "stats": self.server.stats.to_dict(),
                         "pending": self.server.pending(),
                         "conservation": self.server.conservation()}
+        if op == "delta":
+            return self._handle_delta(req, client)
         if op == "drain":
             self.request_drain()
             return {"ok": True, "draining": True}
         return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _handle_delta(self, req: dict, client: _Client) -> dict:
+        """The streaming verb (ISSUE 17): mutate the tenant's RESIDENT
+        slab and optionally re-cluster it warm, answering on the reader
+        thread (synchronous — a delta is one tenant's own slab, there
+        is no batch to join; exactly one response line per request).
+        First contact must carry a graph spec (the one full upload);
+        later deltas find the session resident in the StreamPool and
+        pay only the delta — unless the LRU budget evicted it, in which
+        case the client is told to re-upload."""
+        if self._drain_req.is_set():
+            return {"ok": False, "draining": True,
+                    "error": "daemon is draining; not accepting deltas"}
+        tenant = req.get("tenant")
+        if not tenant:
+            return {"ok": False, "error": "delta needs a tenant"}
+        tenant = str(tenant)
+        graph = None
+        if any(k in req for k in ("graph", "file", "synth")):
+            try:
+                graph = _decode_graph(req)
+            except Exception as e:  # noqa: BLE001 — protocol boundary
+                return {"ok": False, "error": f"bad graph spec: {e!r}"}
+        ins = req.get("ins") or []
+        dels = req.get("del") or []
+        try:
+            with self.lock:
+                # Same recheck as submit: a delta that sees drain_req
+                # here must not touch (or admit to) the pool the drain
+                # epilogue is about to clear.
+                if self._drain_req.is_set():
+                    return {"ok": False, "draining": True,
+                            "error": "daemon is draining; "
+                                     "not accepting deltas"}
+                streams = self.server.streams
+                sess = streams.get(tenant)
+                resident = sess is not None
+                if sess is None:
+                    if graph is None:
+                        return {"ok": False, "resident": False,
+                                "error": f"tenant {tenant!r} has no "
+                                         "resident session (first "
+                                         "contact, or evicted); include "
+                                         "a graph/file/synth spec to "
+                                         "(re-)upload"}
+                    sess = streams.admit(tenant, graph)
+                out = {"ok": True, "tenant": tenant, "resident": resident}
+                if ins or dels:
+                    from cuvite_tpu.stream.delta import DeltaBatch
+
+                    batch = DeltaBatch.from_edits(
+                        sess.nv,
+                        ins_src=[e[0] for e in ins],
+                        ins_dst=[e[1] for e in ins],
+                        ins_w=[(e[2] if len(e) > 2 else 1.0)
+                               for e in ins],
+                        del_src=[e[0] for e in dels],
+                        del_dst=[e[1] for e in dels])
+                    info = sess.apply_delta(batch)
+                    # A spill may have grown the slab class: re-read
+                    # the ledger and let LRU eviction re-balance.
+                    streams.reledger(tenant)
+                    out["delta"] = {k: info[k] for k in
+                                    ("n_ins", "n_del", "n_del_hit", "ne",
+                                     "frontier_frac")}
+                if req.get("recluster"):
+                    warm = str(req.get("warm", "labels"))
+                    if warm == "labels" and sess.labels() is None:
+                        # A fresh (or re-uploaded) session has no prior
+                        # labels: the first recluster is cold by
+                        # construction — reported as such, never a
+                        # silent stale seed.
+                        warm = "cold"
+                    res = sess.recluster(warm=warm)
+                    rc = {"warm": warm,
+                          "q": round(float(res.modularity), 6),
+                          "communities": int(res.num_communities),
+                          "phases": len(res.phases),
+                          "iterations": int(res.total_iterations)}
+                    if req.get("labels"):
+                        rc["labels"] = [int(x) for x in res.communities]
+                    out["recluster"] = rc
+                return out
+        except Exception as e:  # noqa: BLE001 — protocol boundary
+            return {"ok": False, "error": repr(e)}
 
     def _handle_submit(self, req: dict, client: _Client) -> dict:
         if self._drain_req.is_set():
@@ -439,8 +544,15 @@ class ServeDaemon:
         dispatcher thread): emit the serve_summary, notify clients,
         unblock serve_forever."""
         server = self.server
+        # Resident tenant slabs do not outlive the service: evict all
+        # (freeing HBM, emitting one `evict` event each) BEFORE the
+        # summary so its stream block shows the final ledger.
+        server.streams.clear()
         summary = dict(server.stats.to_dict(),
-                       conservation=self.server.conservation())
+                       conservation=self.server.conservation(),
+                       stream=dict(server.streams.to_dict(),
+                                   conservation=server.streams
+                                   .conservation()))
         server.tracer.event("serve_summary", **summary)
         self.summary = summary
         for client in list(self._clients.values()):

@@ -136,6 +136,11 @@ class ServeConfig:
     # Requires `admission` (the SLO and the service estimator live
     # there).
     autotune_b_max: bool = False
+    # Tenant slab residency budget (ISSUE 17): total HBM bytes the
+    # StreamPool may keep resident across per-tenant StreamSessions
+    # before LRU eviction kicks in.  A returning tenant whose session
+    # survived pays only its delta; an evicted one re-uploads.
+    stream_budget_bytes: int = 256 << 20
 
     def __post_init__(self) -> None:
         # Config-time validation (ISSUE 11 satellite): a bad knob must
@@ -165,6 +170,9 @@ class ServeConfig:
                 "autotune_b_max needs admission control: the tuner "
                 "reads the admission SLO and the measured per-class "
                 "service curve (serve/admission.py)")
+        if self.stream_budget_bytes < 1:
+            raise ValueError("stream_budget_bytes must be >= 1, got "
+                             f"{self.stream_budget_bytes}")
         # Round up to a ladder rung (full bins then pack with zero
         # padding), capped at the ladder top — loudly: a silently
         # clamped b_max=1000 serving 64-row batches would mislead
@@ -437,6 +445,167 @@ class ServeStats:
         return out
 
 
+class StreamPool:
+    """Per-tenant resident :class:`~cuvite_tpu.stream.StreamSession`
+    registry under an HBM byte budget (ISSUE 17).
+
+    The pool is the serving side of streaming: a tenant's first
+    ``delta`` builds a session (full slab upload, via the injectable
+    ``factory`` — the pool itself is jax-free, R014); later deltas find
+    it resident and pay only the delta.  Residency is LRU under
+    ``budget_bytes`` of session :meth:`hbm_bytes`: admitting or growing
+    a session evicts least-recently-USED others until the ledger fits
+    (the session being touched is never evicted — a tenant cannot be
+    evicted by its own request).  One session larger than the whole
+    budget is admitted alone (and evicts everyone else): refusing it
+    would make the budget a hard per-tenant cap, which is the
+    admission controller's job, not the pool's.
+
+    Conservation (the chaos invariant, mirroring job conservation):
+    every admitted session is resident or evicted exactly once —
+    ``admitted == resident + evicted`` — and ``bytes_resident`` is
+    exactly the sum of resident sessions' ledger bytes.  All state
+    lives under one ``sync.RLock`` (daemon intake threads race the
+    drain path; concheck's ``delta-vs-drain`` scenario drives the
+    interleavings).
+    """
+
+    def __init__(self, budget_bytes: int, tracer=None, *, factory=None):
+        if tracer is None:
+            from cuvite_tpu.utils.trace import NullTracer
+
+            tracer = NullTracer()
+        self.tracer = tracer
+        self.budget_bytes = int(budget_bytes)
+        if self.budget_bytes < 1:
+            raise ValueError("stream budget must be >= 1 byte")
+        self._factory = factory
+        self.lock = sync.RLock("stream-pool")
+        self._sessions: dict = {}   # graftlint: guarded-by=self.lock — tenant -> StreamSession
+        self._order: list = []      # graftlint: guarded-by=self.lock — LRU, oldest first
+        self._bytes: dict = {}      # graftlint: guarded-by=self.lock — tenant -> ledger bytes
+        self.bytes_resident: int = 0  # graftlint: guarded-by=self.lock
+        self.admitted: int = 0      # graftlint: guarded-by=self.lock
+        self.evicted: int = 0       # graftlint: guarded-by=self.lock
+
+    def _make_session(self, graph):
+        """Build a session OUTSIDE the lock (slab upload is the
+        expensive part); jax stays behind the factory seam."""
+        if self._factory is not None:
+            return self._factory(graph, tracer=self.tracer)
+        from cuvite_tpu.stream.session import StreamSession
+
+        return StreamSession.from_graph(graph, tracer=self.tracer)
+
+    def _touch(self, tenant: str) -> None:
+        # Callers hold self.lock already; the RLock re-entry keeps the
+        # discipline lexical (R019) at zero contention cost.
+        with self.lock:
+            if tenant in self._order:
+                self._order.remove(tenant)
+            self._order.append(tenant)
+
+    def _evict_to_fit(self, keep: str) -> None:
+        # Caller holds self.lock.  Oldest-first, never ``keep``.
+        while self.bytes_resident > self.budget_bytes:
+            victim = next((t for t in self._order if t != keep), None)
+            if victim is None:
+                break
+            self._evict_locked(victim, reason="budget")
+
+    def _evict_locked(self, tenant: str, *, reason: str) -> None:
+        # Callers hold self.lock already (RLock re-entry, as _touch).
+        with self.lock:
+            sess = self._sessions.pop(tenant)
+            nb = self._bytes.pop(tenant)
+            self._order.remove(tenant)
+            self.bytes_resident -= nb
+            self.evicted += 1
+        drop = getattr(sess, "drop", None)
+        if drop is not None:
+            drop()  # release device buffers eagerly (stubs may omit)
+        self.tracer.event("evict", tenant=tenant, bytes=nb,
+                          reason=reason,
+                          bytes_resident=self.bytes_resident,
+                          resident=len(self._sessions))
+
+    def get(self, tenant: str):
+        """The tenant's resident session (LRU-touched), or None."""
+        with self.lock:
+            sess = self._sessions.get(tenant)
+            if sess is not None:
+                self._touch(tenant)
+            return sess
+
+    def admit(self, tenant: str, graph):
+        """Build + admit a session for ``tenant`` (replacing any
+        resident one), evicting LRU others to fit the budget.  Returns
+        the session."""
+        sess = self._make_session(graph)
+        with self.lock:
+            if tenant in self._sessions:
+                self._evict_locked(tenant, reason="replace")
+            nb = int(sess.hbm_bytes())
+            self._sessions[tenant] = sess
+            self._bytes[tenant] = nb
+            self._order.append(tenant)
+            self.bytes_resident += nb
+            self.admitted += 1
+            self._evict_to_fit(keep=tenant)
+        self.tracer.event("stream_admit", tenant=tenant, bytes=nb)
+        return sess
+
+    def reledger(self, tenant: str) -> None:
+        """Re-read a resident session's :meth:`hbm_bytes` after an op
+        that may have grown its slab class (delta spill), then re-run
+        eviction.  No-op for unknown tenants (evicted mid-op)."""
+        with self.lock:
+            sess = self._sessions.get(tenant)
+            if sess is None:
+                return
+            nb = int(sess.hbm_bytes())
+            self.bytes_resident += nb - self._bytes[tenant]
+            self._bytes[tenant] = nb
+            self._evict_to_fit(keep=tenant)
+
+    def evict(self, tenant: str) -> bool:
+        """Explicit eviction (daemon shutdown / operator verb)."""
+        with self.lock:
+            if tenant not in self._sessions:
+                return False
+            self._evict_locked(tenant, reason="explicit")
+            return True
+
+    def clear(self) -> None:
+        with self.lock:
+            for t in list(self._order):
+                self._evict_locked(t, reason="shutdown")
+
+    def conservation(self) -> dict:
+        """Session + byte accounting: every admitted session is
+        resident or evicted exactly once, and the byte ledger is the
+        sum of the residents'."""
+        with self.lock:
+            s = dict(admitted=self.admitted, evicted=self.evicted,
+                     resident=len(self._sessions),
+                     bytes_resident=self.bytes_resident)
+            s["ok"] = (s["admitted"] == s["resident"] + s["evicted"]
+                       and s["bytes_resident"]
+                       == sum(self._bytes.values())
+                       and set(self._order) == set(self._sessions))
+        return s
+
+    def to_dict(self) -> dict:
+        with self.lock:
+            return {
+                "resident": len(self._sessions),
+                "admitted": self.admitted,
+                "evicted": self.evicted,
+                "bytes_resident": self.bytes_resident,
+                "budget_bytes": self.budget_bytes,
+            }
+
+
 class LouvainServer:
     """Synchronous serving core: ``submit()`` enqueues, ``step()`` runs
     every due batch and returns finished ``(job_id, LouvainResult)``
@@ -454,7 +623,8 @@ class LouvainServer:
     """
 
     def __init__(self, config: ServeConfig | None = None, tracer=None,
-                 clock=None, *, sleep=None, faults=None, runner=None):
+                 clock=None, *, sleep=None, faults=None, runner=None,
+                 stream_factory=None):
         self.config = config or ServeConfig()
         if tracer is None:
             from cuvite_tpu.utils.trace import NullTracer
@@ -473,6 +643,13 @@ class LouvainServer:
         # the per-rung service curve; config.b_max stays the cap.
         self.autotuner = (BmaxAutotuner(self.config.admission)
                           if self.config.autotune_b_max else None)
+        # Tenant slab residency (ISSUE 17): per-tenant resident
+        # StreamSessions behind the daemon's `delta` verb, LRU-evicted
+        # under the byte budget.  ``stream_factory`` is the chaos seam
+        # (stub sessions make the delta-vs-drain scenario cheap).
+        self.streams = StreamPool(self.config.stream_budget_bytes,
+                                  tracer=self.tracer,
+                                  factory=stream_factory)
         # Terminal reports for jobs that never produce a result: jobs
         # whose clustering raised -> (job_id, error string) in
         # ``failures`` (poison isolation, see _dispatch); jobs whose

@@ -1,0 +1,29 @@
+"""Streaming subsystem: delta ingestion + warm-start incremental
+re-clustering for live graphs (ISSUE 17).
+
+``DeltaBatch`` canonicalizes edge insert/delete batches;
+``apply_delta_slab`` is THE jitted chokepoint that mutates the resident
+device slab (graftlint R029 forbids slab mutation anywhere else in
+stream/ and serve/); ``StreamSession`` owns a tenant's resident slab
+and runs warm-start re-clustering seeded from the previous labels and
+the delta frontier.
+"""
+
+from cuvite_tpu.stream.delta import (
+    DELTA_PAD_MIN,
+    DeltaBatch,
+    apply_delta_slab,
+    delta_frontier,
+    plp_prepass,
+)
+from cuvite_tpu.stream.session import WARM_MODES, StreamSession
+
+__all__ = [
+    "DELTA_PAD_MIN",
+    "DeltaBatch",
+    "StreamSession",
+    "WARM_MODES",
+    "apply_delta_slab",
+    "delta_frontier",
+    "plp_prepass",
+]

@@ -71,8 +71,23 @@ def _cmd_synth(args) -> int:
         edge_factor=args.edge_factor, bits64=args.bits64,
         write_truth=not args.no_truth,
     )
-    print(json.dumps({"out": out, "result": payload["result"],
-                      "sha256": payload["sha256"]}))
+    line = {"out": out, "result": payload["result"],
+            "sha256": payload["sha256"]}
+    if args.churn:
+        # Deterministic insert/delete stream against the graph just
+        # written (read back, so the churn indexes the REALIZED edge
+        # set), for the streaming warm-start A/B (ISSUE 17).
+        from cuvite_tpu.io.vite import read_vite
+        from cuvite_tpu.workloads.synth import write_churn
+
+        graph = read_vite(out, bits64=args.bits64)
+        churn = write_churn(out, graph, frac=args.churn,
+                            seed=args.churn_seed, batches=args.churn_batches)
+        line["churn"] = {"npz": out + ".churn.npz",
+                         "sha256": churn["sha256"],
+                         "frac": churn["churn_frac"],
+                         "batches": churn["batches"]}
+    print(json.dumps(line))
     return 0
 
 
@@ -155,6 +170,13 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--bits64", action="store_true")
     s.add_argument("--no-truth", action="store_true",
                    help="skip the ground-truth file (large graphs)")
+    s.add_argument("--churn", type=float, metavar="FRAC", default=0.0,
+                   help="also emit a deterministic insert/delete churn "
+                        "stream (<out>.churn.npz + provenance) deleting "
+                        "FRAC of the undirected pairs per batch "
+                        "(streaming warm-start A/B, ISSUE 17)")
+    s.add_argument("--churn-batches", type=int, default=1)
+    s.add_argument("--churn-seed", type=int, default=1)
     s.add_argument("--many", type=int, metavar="K", default=0,
                    help="emit K graphs <out>_<k>.vite on distinct "
                         "splitmix64 streams with ONE set-level "

@@ -158,6 +158,10 @@ def validate_record(rec: dict) -> list:
         # against the serving queue — goodput at an arrival rate under
         # a wait-p95 SLO, with the admission/shedding outcome rates.
         problems.extend(_validate_serve_block(rec.get("serve")))
+        # Optional `stream` block (ISSUE 17): one churn batch against a
+        # resident slab — cold full-run wall vs warm-start delta
+        # re-cluster wall, same graph, same compile guard.
+        problems.extend(_validate_stream_block(rec.get("stream")))
     return problems
 
 
@@ -261,6 +265,50 @@ def _validate_serve_block(serve) -> list:
         problems.append(
             f"serve.engine must be one of {BATCH_ENGINES}, "
             f"got {serve['engine']!r}")
+    return problems
+
+
+# Required keys of the optional `stream` bench block (schema v4 + ISSUE
+# 17): cold_wall_s — a full cold re-cluster of the post-churn graph;
+# delta_wall_s — apply_delta_slab + warm-start re-cluster of the SAME
+# churn on a resident session; speedup — cold/delta (the streaming
+# win); frontier_frac — the delta frontier's share of vertices (how
+# local the churn was — the number the speedup must be read against).
+# `warm` and `churn_frac` tag the A/B arm and the churn size so
+# tools/perf_regress.py gates speedup like-for-like only.
+REQUIRED_STREAM_KEYS = ("cold_wall_s", "delta_wall_s", "speedup",
+                        "frontier_frac")
+
+STREAM_WARM_MODES = ("labels", "plp", "cold")
+
+
+def _validate_stream_block(stream) -> list:
+    if stream is None:
+        return []
+    if not isinstance(stream, dict):
+        return [f"stream must be a dict, got {type(stream).__name__}"]
+    problems = [f"stream block missing key {k!r}"
+                for k in REQUIRED_STREAM_KEYS if k not in stream]
+    if problems:
+        return problems
+    for k in ("cold_wall_s", "delta_wall_s", "speedup"):
+        v = stream[k]
+        if not isinstance(v, (int, float)) or v <= 0:
+            problems.append(f"stream.{k} must be positive, got {v!r}")
+    ff = stream["frontier_frac"]
+    if not isinstance(ff, (int, float)) or not 0.0 <= ff <= 1.0:
+        problems.append(
+            f"stream.frontier_frac must be a fraction in [0, 1], "
+            f"got {ff!r}")
+    if "warm" in stream and stream["warm"] not in STREAM_WARM_MODES:
+        problems.append(
+            f"stream.warm must be one of {STREAM_WARM_MODES}, "
+            f"got {stream['warm']!r}")
+    cf = stream.get("churn_frac")
+    if cf is not None and not (isinstance(cf, (int, float))
+                               and 0.0 < cf < 1.0):
+        problems.append(
+            f"stream.churn_frac must be a fraction in (0, 1), got {cf!r}")
     return problems
 
 
@@ -849,6 +897,141 @@ def run_serve_bench(
     }
 
 
+def run_churn_bench(
+    *,
+    churn_frac: float,
+    scale: int,
+    edge_factor: int = 16,
+    warm: str = "labels",
+    seed: int = 1,
+    platform: str = "cpu",
+    budget_s: float = 420.0,
+    t_start: float | None = None,
+) -> dict:
+    """Streaming warm-start A/B (ISSUE 17): ONE deterministic churn
+    batch (``churn_frac`` of the undirected pairs deleted, as many
+    inserted; workloads/synth.churn_batches) against an rmat-``scale``
+    graph, measured two ways on the SAME machine state:
+
+    * cold — a fresh resident session re-clusters the post-churn graph
+      from scratch (``warm='cold'``: identity seed, full active set);
+    * delta — the resident session ingests the batch through
+      ``apply_delta_slab`` and re-clusters with ``warm`` seeding
+      (previous labels + delta frontier, or the PLP prepass arm).
+
+    Compile discipline matches every other bench: a full warm-up pass
+    exercises BOTH paths (cold re-cluster, delta apply, warm
+    re-cluster) on a throwaway session, then the timed passes run under
+    the compile guard — the streaming claim is *zero fresh compiles per
+    delta*, so a compile inside the timed window is not noise, it is
+    the regression itself.  The record carries the ``stream`` block
+    (cold_wall_s, delta_wall_s, speedup, frontier_frac).
+    """
+    from cuvite_tpu.io.generate import generate_rmat
+    from cuvite_tpu.obs import (
+        NO_TRACE,
+        CompileWatcher,
+        FlightRecorder,
+        convergence_summary,
+    )
+    from cuvite_tpu.stream import DeltaBatch, StreamSession
+    from cuvite_tpu.utils.trace import Tracer, rss_high_water_mb
+    from cuvite_tpu.workloads.synth import churn_batches
+
+    t_start = _T_PROC if t_start is None else t_start
+    if not 0.0 < churn_frac < 1.0:
+        raise ValueError(
+            f"--churn-frac must be in (0, 1), got {churn_frac}")
+    if warm not in STREAM_WARM_MODES:
+        raise ValueError(f"--warm-start must be one of "
+                         f"{STREAM_WARM_MODES}, got {warm!r}")
+
+    t0 = time.perf_counter()
+    graph = generate_rmat(scale, edge_factor=edge_factor, seed=seed)
+    print(f"# graph: rmat scale={scale} nv={graph.num_vertices} "
+          f"ne={graph.num_edges} gen={time.perf_counter()-t0:.1f}s",
+          file=sys.stderr)
+    edits = churn_batches(graph, frac=churn_frac, seed=seed)[0]
+    batch = DeltaBatch.from_edits(
+        graph.num_vertices,
+        ins_src=edits["ins_src"], ins_dst=edits["ins_dst"],
+        ins_w=edits["ins_w"],
+        del_src=edits["del_src"], del_dst=edits["del_dst"])
+
+    frec = FlightRecorder(NO_TRACE, watch_compiles=False)
+
+    # Warm-up: both timed paths, end to end, on a throwaway session.
+    with CompileWatcher(on_event=frec._on_compile):
+        wsess = StreamSession.from_graph(graph)
+        wsess.recluster(warm="cold")
+        wsess.apply_delta(batch)
+        wsess.recluster(warm=warm)
+        del wsess
+    elapsed = time.perf_counter() - t_start
+    if elapsed > budget_s:
+        raise RuntimeError(
+            f"churn bench warm-up alone spent {elapsed:.0f}s of the "
+            f"{budget_s:.0f}s budget; shrink --scale")
+
+    tr = Tracer(recorder=frec)
+    sess = StreamSession.from_graph(graph, tracer=tr)
+    with CompileWatcher(on_event=frec._on_compile) as watch:
+        # Cold arm FIRST, on the pre-churn slab: its wall is the "full
+        # re-run" a non-streaming deployment would pay per update.
+        t1 = time.perf_counter()
+        res_cold = sess.recluster(warm="cold")
+        cold_wall = time.perf_counter() - t1
+        # Delta arm: ingest + warm-start re-cluster on the SAME session.
+        t1 = time.perf_counter()
+        info = sess.apply_delta(batch)
+        res_warm = sess.recluster(warm=warm)
+        delta_wall = time.perf_counter() - t1
+    if watch.compiles:
+        raise BenchCompileGuardError(watch.compiles)
+
+    teps, _clustering_s = _one_teps(res_cold, cold_wall)
+    speedup = cold_wall / max(delta_wall, 1e-9)
+    print(f"# stream: cold={cold_wall:.2f}s delta={delta_wall:.2f}s "
+          f"speedup={speedup:.1f}x frontier={info['frontier_frac']:.4f} "
+          f"Q_cold={res_cold.modularity:.5f} "
+          f"Q_warm={res_warm.modularity:.5f}", file=sys.stderr)
+    return {
+        "metric": "louvain_teps_per_chip",
+        "value": round(teps, 1),
+        "unit": "traversed_edges/sec",
+        "vs_baseline": round(teps / BASELINE_EDGES_PER_SEC_PER_CHIP, 4),
+        "platform": platform,
+        "graph": f"rmat{scale}",
+        "scale": int(scale),
+        # The DELTA arm's quality — the number the golden envelope
+        # judges (a warm start that converged somewhere worse must not
+        # hide behind the cold run's Q).
+        "modularity": round(float(res_warm.modularity), 6),
+        "phases": len(res_warm.phases),
+        "iterations": int(res_warm.total_iterations),
+        "rss_mb": round(rss_high_water_mb(), 1),
+        "compile_guard": {"checked": True, "new_compiles": 0},
+        "stages": tr.breakdown(),
+        "engine": "fused",
+        "schema": BENCH_SCHEMA_VERSION,
+        "convergence_summary": convergence_summary(
+            getattr(res_warm, "convergence", None)),
+        "compile_events": [dict(e) for e in frec.compile_events],
+        "hbm_peak_by_buffer": dict(frec.ledger.peak_by_buffer),
+        "stream": {
+            "cold_wall_s": round(cold_wall, 4),
+            "delta_wall_s": round(delta_wall, 4),
+            "speedup": round(speedup, 3),
+            "frontier_frac": round(float(info["frontier_frac"]), 5),
+            "warm": warm,
+            "churn_frac": float(churn_frac),
+            "n_ins": int(info["n_ins"]),
+            "n_del": int(info["n_del"]),
+            "modularity_cold": round(float(res_cold.modularity), 6),
+        },
+    }
+
+
 def _build_parser() -> argparse.ArgumentParser:
     env = os.environ
     p = argparse.ArgumentParser(
@@ -928,11 +1111,66 @@ def _build_parser() -> argparse.ArgumentParser:
                    help="measured-service b_max autotuning (needs "
                         "admission on); the settled rung lands in "
                         "serve.autotuned_b_max")
+    c = p.add_argument_group("streaming churn A/B (ISSUE 17)")
+    c.add_argument("--churn-frac", type=float, metavar="FRAC",
+                   default=float(env["BENCH_CHURN_FRAC"])
+                   if "BENCH_CHURN_FRAC" in env else None,
+                   help="one deterministic churn batch (FRAC of the "
+                        "undirected pairs deleted + as many inserted) "
+                        "against an rmat --scale graph: cold full "
+                        "re-cluster vs apply_delta_slab + warm-start "
+                        "re-cluster on a resident session; the record "
+                        "carries the `stream` block (cold_wall_s, "
+                        "delta_wall_s, speedup, frontier_frac)")
+    c.add_argument("--warm-start", default="labels",
+                   choices=list(STREAM_WARM_MODES),
+                   help="delta-arm seeding: 'labels' (previous run's "
+                        "composed labels + delta frontier), 'plp' (the "
+                        "label-propagation prepass A/B alternative), or "
+                        "'cold' (identity — the null arm)")
     return p
 
 
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
+
+    if args.churn_frac is not None:
+        if args.batch is not None or args.serve_rate is not None:
+            print("# --churn-frac, --batch and --serve-rate are "
+                  "different benches; pick one", file=sys.stderr)
+            return 2
+        if args.file:
+            print("# --churn-frac generates its own rmat graph: --file "
+                  "does not apply (use --scale)", file=sys.stderr)
+            return 2
+        from cuvite_tpu.utils.compile_cache import enable_compile_cache
+
+        enable_compile_cache()
+        platform = _init_backend()
+        scale = args.scale if args.scale is not None else (
+            18 if platform == "cpu" else 20)
+        try:
+            rec = run_churn_bench(
+                churn_frac=args.churn_frac, scale=scale,
+                edge_factor=args.edge_factor, warm=args.warm_start,
+                platform=platform, budget_s=args.budget,
+            )
+        except BenchCompileGuardError as e:
+            print(f"# BENCH ABORTED: {e}", file=sys.stderr)
+            for line in e.compile_log:
+                print(f"#   {line[:200]}", file=sys.stderr)
+            return 3
+        problems = validate_record(rec)
+        if problems:
+            print(f"# BENCH ABORTED: invalid record: {problems}",
+                  file=sys.stderr)
+            return 4
+        line = json.dumps(rec)
+        print(line)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(line + "\n")
+        return 0
 
     if args.serve_rate is not None:
         if args.batch is not None:

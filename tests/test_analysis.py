@@ -588,6 +588,43 @@ class SingleThreaded:
 """,
         "cuvite_tpu/serve/fake_r019.py",
     ),
+    (
+        "R029",
+        """
+import jax
+import jax.numpy as jnp
+
+
+def hot_patch(sess, i, weight):
+    # direct slab edit outside the apply_delta_slab chokepoint: forks
+    # the canonical form the bit-equality tests pin
+    sess.w = sess.w.at[i].set(weight)
+    sess.src = sess.src.at[i].add(0)
+    return sess
+
+_step = jax.jit(lambda s, d, w: (s, d, w), donate_argnums=(2,))
+""",
+        """
+import jax
+from cuvite_tpu.stream.delta import apply_delta_slab
+
+
+def hot_patch(sess, batch, nv_pad, adt):
+    # every slab edit routed through the ONE jitted chokepoint
+    i_s, i_d, i_w, d_s, d_d = batch.padded(256)
+    return apply_delta_slab(sess.src, sess.dst, sess.w,
+                            i_s, i_d, i_w, d_s, d_d, sess.ne,
+                            nv_pad=nv_pad, accum_dtype=adt)
+
+_step = jax.jit(lambda s, d, w: (s, d, w))
+
+
+def scratch(mask, idx):
+    # a genuinely non-slab update, justified inline
+    return mask.at[idx].set(True)  # graftlint: disable=R029 — local scratch mask, never a resident slab
+""",
+        "cuvite_tpu/stream/fake_r029.py",
+    ),
 ]
 
 RULE_IDS = [c[0] for c in RULE_CASES]

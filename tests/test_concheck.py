@@ -201,6 +201,23 @@ def test_pipeline_clean_across_200_interleavings():
     assert not rep.warnings, rep.warnings
 
 
+def test_delta_vs_drain_across_200_interleavings():
+    """The ISSUE-17 acceptance gate: two tenants' delta streams (every
+    request re-uploads, so a 1500-byte budget over 1000-byte stub
+    sessions keeps the StreamPool admitting/evicting) racing intake and
+    a mid-run drain explore clean, with delta exactly-once, StreamPool
+    session/byte conservation, and zero residents after the drain
+    epilogue asserted per schedule (DaemonScenario.check)."""
+    budget = max(concheck.schedule_budget(), 200)
+    rep = concheck.explore(scenario("delta-vs-drain"), budget=budget,
+                           seed=29)
+    assert rep.clean, (rep.failures()[:3], rep.races()[:3])
+    assert rep.schedules == budget
+    assert rep.distinct >= 200, \
+        f"only {rep.distinct} distinct interleavings explored"
+    assert not rep.warnings, rep.warnings
+
+
 def test_pipeline_faulty_explores_clean():
     """Transient pack + device faults through the pipelined dispatcher:
     retries fire in their home stages (pack on the packer, device on

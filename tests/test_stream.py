@@ -1,0 +1,525 @@
+"""Streaming subsystem tests (ISSUE 17): delta-vs-rebuild bit
+equality through the ``apply_delta_slab`` chokepoint (insert-only,
+delete-only, mixed — including the delete-miss path and a forced slab
+spill), warm-vs-cold label quality inside the golden envelope, stale
+warm-start refusal, zero-fresh-compiles on a second same-class delta,
+churn generator determinism + provenance round-trip, StreamPool
+LRU/ledger accounting (stub sessions, no jax), and the daemon
+``delta`` verb over a unix socket.
+
+The rebuild oracle is the canonical-form contract itself: maintain the
+undirected pair -> weight dict on the host, rebuild a fresh
+``DistGraph`` slab from it, and demand the resident session's
+(src, dst, w) arrays are BIT-equal — same class, same row order, same
+f32 weights.  Churn weights are small dyadic integers (1..8) so f32
+coalescing is exact on both sides.
+"""
+
+import json
+import os
+import types
+
+import numpy as np
+import pytest
+
+from cuvite_tpu.core.distgraph import DistGraph
+from cuvite_tpu.core.graph import Graph
+from cuvite_tpu.obs.compile_watch import CompileWatcher
+from cuvite_tpu.serve import LouvainServer, ServeConfig, ServeDaemon
+from cuvite_tpu.serve.queue import StreamPool
+from cuvite_tpu.stream import DeltaBatch, StreamSession
+from cuvite_tpu.workloads.golden import (
+    check_envelope,
+    envelope_from_measurement,
+)
+from cuvite_tpu.workloads.synth import (
+    churn_batches,
+    load_churn,
+    synthesize_graph,
+    write_churn,
+)
+
+from test_serve_daemon import DaemonClient, stub_runner
+
+NV = 300
+
+
+def _draw_edges(seed: int, n: int, nv: int = NV) -> dict:
+    """Undirected pair -> summed weight dict, dyadic int weights."""
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, nv, 2 * n)
+    dst = rng.integers(0, nv, 2 * n)
+    w = rng.integers(1, 8, 2 * n).astype(np.float64)
+    edges: dict = {}
+    for u, v, ww in zip(src, dst, w):
+        if u == v:
+            continue
+        k = (min(u, v), max(u, v))
+        edges[k] = edges.get(k, 0.0) + ww
+        if len(edges) >= n:
+            break
+    return edges
+
+
+def _graph_from(edges: dict, nv: int = NV) -> Graph:
+    ks = sorted(edges)
+    src = np.array([k[0] for k in ks], dtype=np.int64)
+    dst = np.array([k[1] for k in ks], dtype=np.int64)
+    w = np.array([edges[k] for k in ks], dtype=np.float64)
+    return Graph.from_edges(nv, src, dst, w)
+
+
+def _oracle_apply(edges: dict, *, dels=(), ins=()) -> dict:
+    """The host-side twin of apply_delta: retire deleted pairs, then
+    coalesce inserted pairs by weight sum (misses tolerated)."""
+    out = dict(edges)
+    for u, v in dels:
+        out.pop((min(u, v), max(u, v)), None)
+    for u, v, ww in ins:
+        k = (min(u, v), max(u, v))
+        out[k] = out.get(k, 0.0) + ww
+    return out
+
+
+def _assert_slab_equals_rebuild(sess: StreamSession, edges: dict,
+                                nv: int = NV) -> None:
+    """Bit-equality of the resident slab against a cold rebuild of the
+    same edge set (class, row order, values)."""
+    g2 = _graph_from(edges, nv)
+    dg2 = DistGraph.build(g2, 1, min_nv_pad=4096, min_ne_pad=16384)
+    sh = dg2.shards[0]
+    assert (dg2.nv_pad, dg2.ne_pad) == (sess.nv_pad, sess.ne_pad)
+    assert sh.n_real_edges == sess.ne
+    assert np.array_equal(np.asarray(sess.src),
+                          np.asarray(sh.src).astype(np.int32))
+    assert np.array_equal(np.asarray(sess.dst),
+                          np.asarray(sh.dst).astype(np.int32))
+    assert np.array_equal(np.asarray(sess.w),
+                          np.asarray(sh.w).astype(np.float32))
+    assert abs(sess.tw2 - g2.total_edge_weight_twice()) < 1e-6
+
+
+@pytest.fixture(scope="module")
+def base_edges() -> dict:
+    return _draw_edges(7, 1200)
+
+
+@pytest.fixture
+def session(base_edges):
+    return StreamSession.from_graph(_graph_from(base_edges))
+
+
+# ---------------------------------------------------------------------------
+# delta-vs-rebuild bit equality (the acceptance contract)
+# ---------------------------------------------------------------------------
+
+def test_delta_insert_only_bit_equal(session, base_edges):
+    rng = np.random.default_rng(11)
+    iu = rng.integers(0, NV, 50)
+    iv = rng.integers(0, NV, 50)
+    iw = rng.integers(1, 8, 50).astype(np.float64)
+    keep = iu != iv
+    iu, iv, iw = iu[keep], iv[keep], iw[keep]
+    batch = DeltaBatch.from_edits(NV, ins_src=iu, ins_dst=iv, ins_w=iw)
+    info = session.apply_delta(batch)
+    assert info["n_del"] == 0 and info["n_ins"] == 2 * len(iu)
+    _assert_slab_equals_rebuild(
+        session, _oracle_apply(base_edges, ins=zip(iu, iv, iw)))
+
+
+def test_delta_delete_only_bit_equal(session, base_edges):
+    rng = np.random.default_rng(13)
+    keys = sorted(base_edges)
+    dels = [keys[i] for i in rng.choice(len(keys), 40, replace=False)]
+    batch = DeltaBatch.from_edits(NV, del_src=[k[0] for k in dels],
+                                  del_dst=[k[1] for k in dels])
+    info = session.apply_delta(batch)
+    assert info["n_ins"] == 0
+    assert info["n_del_hit"] == info["n_del"] == 2 * len(dels)
+    _assert_slab_equals_rebuild(session, _oracle_apply(base_edges,
+                                                       dels=dels))
+
+
+def test_delta_mixed_bit_equal_and_label_determinism(session, base_edges):
+    """Mixed batch with (a) an insert that coalesces onto a resident
+    pair, (b) a delete of a pair that does not exist (miss path), and
+    (c) fresh inserts — then the cold labels on the delta'd slab must
+    equal the cold labels on a rebuilt-from-scratch session."""
+    rng = np.random.default_rng(17)
+    keys = sorted(base_edges)
+    dels = [keys[i] for i in rng.choice(len(keys), 25, replace=False)]
+    missing = next((u, v) for u in range(NV) for v in range(u + 1, NV)
+                   if (u, v) not in base_edges and (u, v) not in dels)
+    dels_req = dels + [missing]
+    resident = keys[3]  # coalesce target: already in the slab
+    iu = np.concatenate([rng.integers(0, NV, 30), [resident[0]]])
+    iv = np.concatenate([rng.integers(0, NV, 30), [resident[1]]])
+    iw = np.concatenate([rng.integers(1, 8, 30).astype(np.float64),
+                         [2.0]])
+    keep = iu != iv
+    iu, iv, iw = iu[keep], iv[keep], iw[keep]
+    batch = DeltaBatch.from_edits(
+        NV, ins_src=iu, ins_dst=iv, ins_w=iw,
+        del_src=[k[0] for k in dels_req],
+        del_dst=[k[1] for k in dels_req])
+    info = session.apply_delta(batch)
+    # the phantom delete misses; the real ones all hit (mirrored count)
+    assert info["n_del"] == 2 * len(dels_req)
+    assert info["n_del_hit"] == 2 * len(dels)
+    after = _oracle_apply(base_edges, dels=dels_req,
+                          ins=zip(iu, iv, iw))
+    _assert_slab_equals_rebuild(session, after)
+    # identical slabs => identical cold clustering, bit for bit
+    r_delta = session.recluster(warm="cold")
+    r_rebuild = StreamSession.from_graph(
+        _graph_from(after)).recluster(warm="cold")
+    assert np.array_equal(np.asarray(r_delta.communities),
+                          np.asarray(r_rebuild.communities))
+    assert abs(r_delta.modularity - r_rebuild.modularity) < 1e-9
+
+
+def _session_at_class(graph, min_ne_pad):
+    """from_graph at an explicit ne_pad floor (a small class keeps the
+    spill test off the expensive 16k/32k-row compiles)."""
+    import jax.numpy as jnp
+
+    from cuvite_tpu.utils.checkpoint import graph_fingerprint
+
+    dg = DistGraph.build(graph, 1, min_nv_pad=4096,
+                         min_ne_pad=min_ne_pad)
+    sh = dg.shards[0]
+    return StreamSession(
+        nv=graph.num_vertices, nv_pad=dg.nv_pad, ne_pad=dg.ne_pad,
+        ne=sh.n_real_edges,
+        src=jnp.asarray(np.asarray(sh.src).astype(np.int32)),
+        dst=jnp.asarray(np.asarray(sh.dst).astype(np.int32)),
+        w=jnp.asarray(np.asarray(sh.w).astype(np.float32)),
+        tw2=graph.total_edge_weight_twice(), policy=graph.policy,
+        fingerprint=graph_fingerprint(graph))
+
+
+def test_delta_spill_grows_class_and_stays_bit_equal():
+    """A batch overflowing the padding headroom must reshape to the
+    next pow2 class (grow_slab path) and still match the rebuild."""
+    edges = _draw_edges(23, 2040)
+    assert 4000 < 2 * len(edges) <= 4096
+    sess = _session_at_class(_graph_from(edges), min_ne_pad=4096)
+    assert sess.ne_pad == 4096
+    rng = np.random.default_rng(29)
+    fresh = []
+    while len(fresh) < 60:
+        u, v = (int(x) for x in rng.integers(0, NV, 2))
+        k = (min(u, v), max(u, v))
+        if u != v and k not in edges and k not in dict(fresh):
+            fresh.append((k, float(rng.integers(1, 8))))
+    iu = [k[0] for k, _ in fresh]
+    iv = [k[1] for k, _ in fresh]
+    iw = [w for _, w in fresh]
+    info = sess.apply_delta(
+        DeltaBatch.from_edits(NV, ins_src=iu, ins_dst=iv, ins_w=iw))
+    assert sess.ne_pad == 8192, "spill must grow the slab class"
+    assert info["ne"] == sess.ne
+    after = _oracle_apply(edges, ins=zip(iu, iv, iw))
+    g2 = _graph_from(after)
+    dg2 = DistGraph.build(g2, 1, min_nv_pad=4096, min_ne_pad=4096)
+    sh = dg2.shards[0]
+    assert dg2.ne_pad == sess.ne_pad and sh.n_real_edges == sess.ne
+    assert np.array_equal(np.asarray(sess.src),
+                          np.asarray(sh.src).astype(np.int32))
+    assert np.array_equal(np.asarray(sess.dst),
+                          np.asarray(sh.dst).astype(np.int32))
+    assert np.array_equal(np.asarray(sess.w),
+                          np.asarray(sh.w).astype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# warm-start re-clustering
+# ---------------------------------------------------------------------------
+
+def test_warm_start_within_golden_envelope_then_zero_compiles():
+    """On a planted-community graph, warm-start labels after churn must
+    land inside the golden envelope derived from the cold full re-run
+    of the SAME post-churn graph (Q_TOL/PHASE_SLACK/COMM_REL) — and the
+    NEXT same-class delta batch must then run entirely on cached
+    executables (the steady-state zero-fresh-compiles contract)."""
+    g = synthesize_graph(6000, seed=3, mu=0.12)
+    b0, b1 = churn_batches(g, frac=0.01, seed=5, batches=2)
+
+    def to_batch(arrs):
+        return DeltaBatch.from_edits(
+            g.num_vertices,
+            ins_src=arrs["ins_src"], ins_dst=arrs["ins_dst"],
+            ins_w=arrs["ins_w"], del_src=arrs["del_src"],
+            del_dst=arrs["del_dst"])
+
+    batch = to_batch(b0)
+    warm_sess = StreamSession.from_graph(g)
+    warm_sess.recluster(warm="cold")          # seed resident labels
+    info = warm_sess.apply_delta(batch)
+    assert 0.0 < info["frontier_frac"] <= 1.0
+    warm = warm_sess.recluster(warm="labels")
+
+    cold_sess = StreamSession.from_graph(g)
+    cold_sess.apply_delta(batch)
+    cold = cold_sess.recluster(warm="cold")
+
+    env = envelope_from_measurement({
+        "modularity": cold.modularity, "phases": len(cold.phases),
+        "communities": cold.num_communities})
+
+    def degradations(res):
+        # The envelope guards against DEGRADATION; a warm start that
+        # lands in a better optimum (Q above the band) is not a
+        # regression, so the Q check is one-sided here.
+        problems = check_envelope(env, {
+            "modularity": res.modularity, "phases": len(res.phases),
+            "communities": res.num_communities})
+        return [p for p in problems
+                if not (p.startswith("Q=")
+                        and res.modularity >= cold.modularity)]
+
+    assert not degradations(warm), degradations(warm)
+    plp = warm_sess.recluster(warm="plp")
+    assert not degradations(plp), degradations(plp)
+
+    # one cycle warmed every executable: the second same-class batch
+    # (same pow2 slot class by construction) compiles NOTHING
+    with CompileWatcher() as w:
+        warm_sess.apply_delta(to_batch(b1))
+    assert not w.compiles, w.compiles
+    with CompileWatcher() as w:
+        warm_sess.recluster(warm="labels")
+    assert not w.compiles, w.compiles
+
+
+def test_stale_warm_start_refused(session, base_edges):
+    with pytest.raises(ValueError, match="needs resident labels"):
+        session.recluster(warm="labels")
+    res = session.recluster(warm="cold")
+    fp_before = session.fingerprint
+    session.apply_delta(DeltaBatch.from_edits(
+        NV, ins_src=[1], ins_dst=[2], ins_w=[1.0]))
+    # labels stamped with a fingerprint from another lineage: refuse
+    with pytest.raises(ValueError, match="stale warm-start refused"):
+        session.recluster(warm="labels",
+                          warm_labels=np.asarray(res.communities),
+                          warm_fingerprint=0xDEAD)
+    # the true pre-delta lineage fingerprint is accepted
+    ok = session.recluster(warm="labels",
+                           warm_labels=np.asarray(res.communities),
+                           warm_fingerprint=fp_before)
+    assert ok.num_communities >= 1
+
+
+# ---------------------------------------------------------------------------
+# churn generator (workloads satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def churn_graph():
+    return synthesize_graph(2000, seed=3)
+
+
+def test_churn_deterministic_and_disjoint_deletes(churn_graph):
+    g = churn_graph
+    a = churn_batches(g, frac=0.05, seed=9, batches=2)
+    b = churn_batches(g, frac=0.05, seed=9, batches=2)
+    for ba, bb in zip(a, b):
+        for k in ba:
+            assert np.array_equal(ba[k], bb[k]), k
+    c = churn_batches(g, frac=0.05, seed=10, batches=2)[0]
+    assert not np.array_equal(a[0]["ins_src"], c["ins_src"])
+    # deletes sampled without replacement ACROSS batches
+    d0 = set(zip(a[0]["del_src"], a[0]["del_dst"]))
+    d1 = set(zip(a[1]["del_src"], a[1]["del_dst"]))
+    assert not d0 & d1
+    for ba in a:
+        assert np.all((ba["ins_w"] >= 1.0) & (ba["ins_w"] <= 8.0))
+        assert np.all(ba["ins_w"] == np.round(ba["ins_w"]))
+        assert np.all(ba["ins_src"] != ba["ins_dst"])
+
+
+def test_churn_provenance_round_trip(tmp_path, churn_graph):
+    g = churn_graph
+    out = str(tmp_path / "g")
+    payload = write_churn(out, g, frac=0.05, seed=9, batches=2)
+    assert payload["source"] == "churn" and payload["sha256"]
+    with open(out + ".churn.provenance.json", encoding="utf-8") as f:
+        on_disk = json.load(f)
+    assert on_disk["churn_seed"] == 9 and on_disk["batches"] == 2
+    loaded = load_churn(out)
+    fresh = churn_batches(g, frac=0.05, seed=9, batches=2)
+    assert len(loaded) == 2
+    for bl, bf in zip(loaded, fresh):
+        for k in bf:
+            assert np.array_equal(bl[k], bf[k]), k
+
+
+# ---------------------------------------------------------------------------
+# StreamPool (serve satellite) — stub sessions, no jax
+# ---------------------------------------------------------------------------
+
+class _StubSess:
+    def __init__(self, graph, tracer=None, nbytes=1000):
+        self.nbytes = nbytes
+        self.dropped = 0
+
+    def hbm_bytes(self):
+        return self.nbytes
+
+    def drop(self):
+        self.dropped += 1
+
+
+def _pool(budget, nbytes=1000):
+    made = []
+
+    def factory(graph, tracer=None):
+        s = _StubSess(graph, tracer, nbytes=nbytes)
+        made.append(s)
+        return s
+
+    return StreamPool(budget, factory=factory), made
+
+
+def test_pool_lru_eviction_and_conservation():
+    pool, made = _pool(2500)
+    sa = pool.admit("a", None)
+    sb = pool.admit("b", None)
+    assert pool.conservation()["ok"]
+    pool.admit("c", None)               # 3000 > 2500: evict oldest (a)
+    assert pool.get("a") is None and sa.dropped == 1
+    assert pool.get("b") is sb          # touch: b is now newest
+    pool.admit("d", None)               # evicts c, not the touched b
+    assert pool.get("c") is None and pool.get("b") is sb
+    cons = pool.conservation()
+    assert cons["ok"] and cons["resident"] == 2 and cons["evicted"] == 2
+    assert cons["bytes_resident"] == 2000
+    pool.clear()
+    cons = pool.conservation()
+    assert cons["ok"] and cons["resident"] == 0
+    assert cons["bytes_resident"] == 0
+    assert all(s.dropped == 1 for s in made)
+
+
+def test_pool_replace_and_oversized_sole_tenant():
+    pool, _ = _pool(2500)
+    s1 = pool.admit("t", None)
+    s2 = pool.admit("t", None)          # replace, not a second resident
+    assert s2 is not s1 and s1.dropped == 1
+    cons = pool.conservation()
+    assert cons["ok"] and cons["resident"] == 1 and cons["evicted"] == 1
+    # a session larger than the whole budget stays resident when alone
+    big_pool, _ = _pool(500, nbytes=1000)
+    sb = big_pool.admit("big", None)
+    assert big_pool.get("big") is sb
+    assert big_pool.conservation()["ok"]
+
+
+def test_pool_reledger_after_spill_evicts_to_fit():
+    pool, _ = _pool(2500)
+    pool.admit("a", None)
+    sb = pool.admit("b", None)
+    sb.nbytes = 2000                    # b's slab class grew (spill)
+    pool.reledger("b")
+    assert pool.get("a") is None and pool.get("b") is sb
+    cons = pool.conservation()
+    assert cons["ok"] and cons["bytes_resident"] == 2000
+    pool.reledger("ghost")              # evicted-mid-op tenants: no-op
+    assert pool.conservation()["ok"]
+
+
+# ---------------------------------------------------------------------------
+# daemon `delta` verb (wire protocol)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def stream_daemon(tmp_path):
+    srv = LouvainServer(
+        ServeConfig(b_max=2, linger_s=0.01, engine="fused",
+                    stream_budget_bytes=10_000),
+        runner=stub_runner,
+        stream_factory=lambda graph, tracer=None: _StreamStub(graph))
+    d = ServeDaemon(srv, sock_path=str(tmp_path / "serve.sock"),
+                    poll_s=0.005)
+    d.start()
+    yield d
+    if not d._done.is_set():
+        d.request_drain()
+        d.serve_forever(timeout=30.0)
+
+
+class _StreamStub:
+    """Daemon-facing stub: real DeltaBatch in, canned info out."""
+
+    def __init__(self, graph):
+        self.nv = graph.num_vertices
+        self.ne = graph.num_edges
+        self._labels = None
+
+    def hbm_bytes(self):
+        return 1000
+
+    def labels(self):
+        return self._labels
+
+    def apply_delta(self, batch):
+        self.ne = self.ne + batch.n_ins
+        return {"n_ins": batch.n_ins, "n_del": batch.n_del,
+                "n_del_hit": 0, "ne": self.ne, "frontier_frac": 0.25,
+                "wall_s": 0.0}
+
+    def recluster(self, warm="labels", **kw):
+        self._labels = np.zeros(self.nv, dtype=np.int64)
+        return types.SimpleNamespace(
+            modularity=0.5, num_communities=2, phases=[1],
+            total_iterations=3, communities=self._labels)
+
+
+def test_daemon_delta_verb(stream_daemon, tmp_path):
+    c = DaemonClient(str(tmp_path / "serve.sock"))
+    gspec = {"nv": 8, "src": [0, 1, 2, 3], "dst": [1, 2, 3, 4]}
+    try:
+        # first contact with no resident session and no graph: refused
+        r = c.call({"op": "delta", "tenant": "t0", "ins": [[0, 1]]})
+        assert not r["ok"] and r["resident"] is False
+        assert "upload" in r["error"]
+        # upload + delta in one request ("resident" reports the
+        # pre-request state: this admit is a fresh upload)
+        r = c.call({"op": "delta", "tenant": "t0", "graph": gspec,
+                    "ins": [[0, 5], [1, 6, 2.0]], "del": [[0, 1]]})
+        assert r["ok"] and r["resident"] is False
+        assert r["delta"]["n_ins"] == 4 and r["delta"]["n_del"] == 2
+        assert r["delta"]["frontier_frac"] == 0.25
+        # resident now: a bare delta needs no graph spec
+        r = c.call({"op": "delta", "tenant": "t0", "ins": [[2, 7]],
+                    "recluster": True, "warm": "labels"})
+        assert r["ok"] and r["resident"] is True and "recluster" in r
+        # no resident labels yet: warm request downgrades loudly
+        assert r["recluster"]["warm"] == "cold"
+        r = c.call({"op": "delta", "tenant": "t0", "ins": [[3, 7]],
+                    "recluster": True, "warm": "labels",
+                    "labels": True})
+        assert r["recluster"]["warm"] == "labels"
+        assert len(r["recluster"]["labels"]) == 8
+        # a second tenant gets its own session
+        r = c.call({"op": "delta", "tenant": "t1", "graph": gspec,
+                    "ins": [[0, 2]]})
+        assert r["ok"] and r["resident"] is False
+        assert stream_daemon.server.streams.to_dict()["resident"] == 2
+        # a draining daemon admits no further deltas: either an
+        # explicit refusal or (when the idle drain wins the race and
+        # closes the socket first) a dropped connection
+        stream_daemon.request_drain()
+        try:
+            r = c.call({"op": "delta", "tenant": "t0", "ins": [[4, 7]]})
+            refused = (not r["ok"]) and bool(r.get("draining"))
+        except (ConnectionResetError, BrokenPipeError, AssertionError):
+            refused = True
+        assert refused
+    finally:
+        c.close()
+    stream_daemon.serve_forever(timeout=30.0)
+    # shutdown released every resident session (conservation holds)
+    cons = stream_daemon.server.streams.conservation()
+    assert cons["ok"] and cons["resident"] == 0

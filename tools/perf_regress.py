@@ -154,6 +154,19 @@ def comparable(fresh: dict, rec: dict) -> bool:
         if bool(fs.get("pipelined", False)) != bool(rs.get("pipelined",
                                                            False)):
             return False
+    # Streaming churn records (ISSUE 17) gate like-for-like only: a
+    # stream record never compares against a batch/serve/plain-TEPS
+    # record (its cold arm re-clusters a resident slab, not the bench's
+    # graph pipeline), and within the stream trajectory the warm arm
+    # and the churn size must match — the 'labels' speedup sits above
+    # 'plp' by design, and a 10% churn's frontier dwarfs a 1% one's.
+    ft, rt = fresh.get("stream"), rec.get("stream")
+    if (ft is None) != (rt is None):
+        return False
+    if ft is not None:
+        for k in ("warm", "churn_frac"):
+            if ft.get(k) != rt.get(k):
+                return False
     return True
 
 
@@ -240,6 +253,31 @@ def check_regression(fresh: dict, trajectory: list, threshold: float,
                     f"best {old_gp:.3g} (round {sn}, b_max="
                     f"{fresh['serve'].get('b_max')}, admission="
                     f"{fresh['serve'].get('admission')}); gate allows "
+                    f"{threshold:.0%}")
+    # Streaming-speedup gate (ISSUE 17): cold/delta wall ratio of a
+    # churn record against the best comparable stream record
+    # (comparable() already pinned the warm arm and churn_frac, and
+    # keeps stream records out of every batch/serve/TEPS comparison).
+    # The ratio is the gated number — walls alone drift with the host,
+    # but cold and delta share one machine state by construction.
+    if isinstance(fresh.get("stream"), dict):
+        tpeers = [(n, rec) for n, rec in peers
+                  if isinstance(rec.get("stream"), dict)
+                  and isinstance(rec["stream"].get("speedup"),
+                                 (int, float))]
+        if tpeers and isinstance(fresh["stream"].get("speedup"),
+                                 (int, float)):
+            tn, tbest = max(tpeers,
+                            key=lambda p: p[1]["stream"]["speedup"])
+            old_sp = tbest["stream"]["speedup"]
+            new_sp = fresh["stream"]["speedup"]
+            if new_sp < old_sp * (1.0 - threshold):
+                problems.append(
+                    f"stream speedup {new_sp:.3g}x is "
+                    f"{1.0 - new_sp / old_sp:.0%} below the trajectory "
+                    f"best {old_sp:.3g}x (round {tn}, warm="
+                    f"{fresh['stream'].get('warm')}, churn_frac="
+                    f"{fresh['stream'].get('churn_frac')}); gate allows "
                     f"{threshold:.0%}")
     # Stage-level gate: against the most recent comparable record that
     # carries stages (schema v2+ — early rounds predate the breakdown).

@@ -393,6 +393,39 @@ def stage_j(platform):
         f"(json: {out_path})")
 
 
+def stage_k():
+    """Streaming churn A/B on chip (ISSUE 17): cold full re-cluster vs
+    resident-slab delta + warm re-cluster at 1% churn on rmat-20,
+    across the three warm arms (labels / plp prepass / cold control).
+    On a TPU the cold arm pays the full upload + pipeline while the
+    delta arm touches only the resident slab — the speedup this stage
+    measures is the one the CPU baseline understates (host arrays make
+    'resident' nearly free).  Each arm writes its own compile-guarded
+    schema-v4 JSON with a `stream` block the moment it exists; rc=3
+    means a timed window recompiled (no JSON by design)."""
+    for warm in ("labels", "plp", "cold"):
+        out_path = os.path.join(
+            REPO, f"tools/bench_tpu_stream_{warm}.json")
+        t0 = time.perf_counter()
+        try:
+            out = subprocess.run(
+                [sys.executable, "-m", "cuvite_tpu.workloads",
+                 "bench", "--churn-frac", "0.01", "--scale", "20",
+                 "--warm-start", warm, "--out", out_path],
+                capture_output=True, text=True, timeout=1800,
+                cwd=REPO)
+        except subprocess.TimeoutExpired:
+            log(f"K: churn warm={warm} TIMEOUT (1800s)")
+            continue
+        last = out.stdout.strip().splitlines()
+        log(f"K: churn warm={warm} rc={out.returncode} "
+            f"wall={time.perf_counter()-t0:.0f}s "
+            f"json={last[-1] if last else out.stderr[-200:]}")
+        if out.returncode == 3:
+            log("K: compile guard tripped — a timed stream window "
+                "recompiled; no JSON by design")
+
+
 def main():
     parts = probe()
     if parts is None:
@@ -477,6 +510,12 @@ def main():
         stage_j(parts[0])
     except Exception as e:
         log(f"J: FAILED {type(e).__name__}: {e}")
+    # Stage K (ISSUE 17): the streaming churn A/B on chip — cold vs
+    # delta + warm re-cluster across the three warm arms at rmat-20.
+    try:
+        stage_k()
+    except Exception as e:
+        log(f"K: FAILED {type(e).__name__}: {e}")
     if got_tpu_json:
         with open(DONE, "w") as f:
             f.write(time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()) + "\n")
