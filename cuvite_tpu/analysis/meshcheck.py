@@ -29,6 +29,13 @@ grades three properties no AST walk can check:
     category declared ``replicated`` is allowed but must be LISTED —
     the manifest is the closed inventory.  "The community table is
     O(nv_total) per chip" (round-8) is now a failing test, not a note.
+    Budget v2 adds the per-axis law ``ici_replicated`` for the
+    two-level exchange: per-device bytes may reach the full extent over
+    |dcn| (tables replicate inside the fast ICI submesh only), graded
+    on the jaxpr-derived ``exchange_tables`` category
+    (:func:`exchange_table_bytes` — the in-program all_gather/psum
+    outputs the driver-buffer ledger cannot see) and on the dcn-sharded
+    grouped routing (``exchange_grouped``).
 
 Dynamic results are NEVER cached (the concheck precedent): every audit
 re-runs the entries; only the static tier rides the incremental lint
@@ -53,11 +60,19 @@ from cuvite_tpu.analysis.engine import Finding
 # (spmd_axis_size, spare) factorizations of tier-1's 8-virtual-device
 # pool: the 1-D entries use the first dim (vertex shards for the solo
 # step, batch shards for the batched programs); the second dim is the
-# idle remainder — the shape a future two-level ICI/DCN mesh would
-# claim.
+# idle remainder.  The two-level entry (bucketed_twolevel) reads the
+# SAME tuples as (dcn, ici) hybrid-mesh factorizations — all eight
+# devices active, community tables gathered only inside the ici
+# submesh.
 MESH_SHAPES = ((8, 1), (4, 2), (2, 4))
 
-BUDGET_VERSION = 1
+# Version 2 adds per-axis scaling laws ('ici_replicated': per-device
+# bytes must shrink ~1/|dcn| of the full-table extent) next to v1's
+# mesh-wide 'sharded'/'replicated'.  v1 manifests still load (they
+# simply lack the per-axis categories, which then fail CLOSED as
+# unlisted).
+BUDGET_VERSION = 2
+_BUDGET_VERSIONS_OK = (1, 2)
 
 DEFAULT_BUDGET_REL = os.path.join("tools", "replication_budget.json")
 
@@ -149,6 +164,62 @@ def collective_sequence(jaxpr):
 
 def _has_collective(seq) -> bool:
     return bool(_flat_names(seq))
+
+
+# Collective primitives whose OUTPUT is identical on every device of
+# the reduced/gathered axes — i.e. the ones that materialize replicated
+# tables.  all_to_all/ppermute move distinct data and are excluded (the
+# sparse ghost channels are O(budget), not tables).
+_REPLICATING_PRIMS = ("all_gather", "psum")
+
+
+def exchange_table_bytes(jaxpr, axis_sizes: dict) -> dict:
+    """Per-device bytes of replicating collective outputs (all_gather /
+    non-scalar psum) in one traced step program — the in-program
+    community tables the HBM ledger cannot see (they are never
+    driver-placed buffers).
+
+    Returns an M003 ledger row ``{"global": g, "per_device": p}``.
+    ``per_device`` sums the output nbytes as the program holds them on
+    one device.  ``global`` is each table's full-extent bytes: output
+    nbytes times the number of DISTINCT copies across the mesh (total
+    devices over the product of the collective's axis sizes — devices
+    inside the collective's axes hold identical data by definition).
+    An honest ici-scoped gather and its sabotaged global-axis widening
+    therefore report the SAME ``global`` (the table covers all vertices
+    either way) while ``per_device`` differs by the factor |dcn| —
+    exactly the gap the ``ici_replicated`` law grades."""
+    total = 1
+    for v in axis_sizes.values():
+        total *= max(int(v), 1)
+    per_device = 0
+    global_b = 0
+
+    def walk(jx):
+        nonlocal per_device, global_b
+        core = getattr(jx, "jaxpr", jx)
+        for eqn in getattr(core, "eqns", ()):
+            name = eqn.primitive.name
+            if any(m in name for m in _REPLICATING_PRIMS) \
+                    and "scatter" not in name:
+                copies = 1
+                for a in _axes_of(eqn):
+                    copies *= max(int(axis_sizes.get(a, 1)), 1)
+                for ov in eqn.outvars:
+                    aval = getattr(ov, "aval", None)
+                    shape = getattr(aval, "shape", ())
+                    if not shape:
+                        continue  # scalar psums are not tables
+                    nbytes = int(np.prod(shape)) * \
+                        np.dtype(aval.dtype).itemsize
+                    per_device += nbytes
+                    global_b += nbytes * max(total // copies, 1)
+            for key in sorted(eqn.params):
+                for sub in _subjaxprs_of(eqn.params[key]):
+                    walk(sub)
+
+    walk(jaxpr)
+    return {"global": int(global_b), "per_device": int(per_device)}
 
 
 def _mfind(rule: str, entry: str, message: str, snippet: str = "") -> Finding:
@@ -268,14 +339,20 @@ def check_replication(entry: str, ledger_by_shape: dict,
                       manifest: dict) -> list:
     """M003: per-device ledger bytes vs the declared scaling law.
 
-    ``ledger_by_shape``: {tag: {"devices": n, "categories":
-    {cat: {"global": g, "per_device": p}}}}."""
+    ``ledger_by_shape``: {tag: {"devices": n, "axes": {axis: size},
+    "categories": {cat: {"global": g, "per_device": p}}}}.  ``axes``
+    (optional, v2) carries the hybrid-mesh factorization the
+    ``ici_replicated`` law divides by: per-device bytes may be the full
+    extent over |dcn| (replicated inside the fast submesh only), so the
+    allowance is ``global/|dcn| * tol + floor`` — a table widened back
+    to the global axis blows through it by the factor |dcn|."""
     cats = manifest.get("categories", {})
     out = []
     seen = set()
     for tag in sorted(ledger_by_shape):
         rep = ledger_by_shape[tag]
         n = max(int(rep.get("devices", 1)), 1)
+        n_dcn = max(int(rep.get("axes", {}).get("dcn", 1)), 1)
         for cat, row in sorted(rep.get("categories", {}).items()):
             g = int(row.get("global", 0))
             p = int(row.get("per_device", g))
@@ -308,6 +385,22 @@ def check_replication(entry: str, ledger_by_shape: dict,
                         "round-8 measured; shard it or declare it "
                         "'replicated' with a reason",
                         snippet=cat))
+            elif law == "ici_replicated":
+                allowed = g / n_dcn * SHARDED_TOL + SHARDED_FLOOR_BYTES
+                if p > allowed:
+                    out.append(_mfind(
+                        "M003", entry,
+                        f"'{entry}' at mesh shape {tag}: category "
+                        f"'{cat}' holds {p} bytes per device but its "
+                        f"declared law is 'ici_replicated' (full extent "
+                        f"{g} over |dcn|={n_dcn} allows ~{int(allowed)})"
+                        ": a community table is replicated past the "
+                        "fast ICI submesh — the two-level exchange "
+                        "exists to keep per-device table bytes at "
+                        "O(nv_total/|dcn|); gather it on the ici axis "
+                        "only, or route it through the sparse ghost "
+                        "protocol on dcn",
+                        snippet=cat))
     return out
 
 
@@ -318,7 +411,7 @@ def check_replication(entry: str, ledger_by_shape: dict,
 def load_budget(path: str) -> dict:
     with open(path, encoding="utf-8") as fh:
         data = json.load(fh)
-    if data.get("version") != BUDGET_VERSION:
+    if data.get("version") not in _BUDGET_VERSIONS_OK:
         raise ValueError(f"replication budget {path!r}: unsupported "
                          f"version {data.get('version')!r}")
     return data
@@ -346,9 +439,11 @@ class ShapeReport:
         self.seq: tuple = ()
         self.intrinsic: list = []    # M001 findings from the jaxpr
         self.categories: dict = {}   # cat -> {"global", "per_device"}
+        self.axes: dict = {}         # mesh axis sizes, e.g. {"dcn": 2}
 
     def ledger_row(self) -> dict:
-        return {"devices": self.devices, "categories": self.categories}
+        return {"devices": self.devices, "axes": self.axes,
+                "categories": self.categories}
 
 
 def _audit_graph(nv: int = 2048, ne: int = 8192):
@@ -437,9 +532,49 @@ def _solo_report(shape, exchange: str, *, cutover: bool = False):
                              exchange=exchange)
         jaxpr = jax.make_jaxpr(
             lambda c: runner._call(c, runner._extra))(runner.comm0)
+    report.axes = {"v": S}
+    report.categories["exchange_tables"] = exchange_table_bytes(
+        jaxpr, report.axes)
     report.seq, _ = collective_sequence(jaxpr)
     report.intrinsic += lint_collective_jaxpr(
         jaxpr, f"bucketed_{'cutover' if cutover else exchange}")
+    return report
+
+
+def _twolevel_report(shape):
+    """Run the two-level ICI/DCN entry with ``shape`` read as the
+    (dcn, ici) hybrid-mesh factorization of the 8-device pool: labels
+    via the real driver (mesh_shape plumbing included), step jaxpr via
+    a directly-built PhaseRunner on the hybrid mesh.  The jaxpr feeds
+    both M001 and the 'exchange_tables' per-axis ledger row — the
+    community tables are in-program all_gathers, invisible to the HBM
+    ledger's driver-buffer view."""
+    import jax
+
+    from cuvite_tpu.comm.mesh import make_hybrid_mesh
+    from cuvite_tpu.core.distgraph import DistGraph
+    from cuvite_tpu.louvain.driver import PhaseRunner, louvain_phases
+
+    n_dcn, n_ici = shape
+    g = _audit_graph()
+    report = ShapeReport(f"{n_dcn}x{n_ici}", n_dcn * n_ici)
+    report.axes = {"dcn": n_dcn, "ici": n_ici}
+    rec, tracer = _recorder()
+    res = louvain_phases(g, nshards=n_dcn * n_ici, engine="bucketed",
+                         exchange="twolevel", mesh_shape=shape,
+                         max_phases=1, tracer=tracer, verbose=False)
+    report.labels = [(np.asarray(res.communities),
+                      float(res.modularity))]
+    report.categories = _ledger_categories(rec.ledger)
+    dg = DistGraph.build(g, n_dcn * n_ici)
+    runner = PhaseRunner(dg, mesh=make_hybrid_mesh(n_dcn, n_ici),
+                         engine="bucketed", exchange="twolevel")
+    jaxpr = jax.make_jaxpr(
+        lambda c: runner._call(c, runner._extra))(runner.comm0)
+    report.categories["exchange_tables"] = exchange_table_bytes(
+        jaxpr, report.axes)
+    report.seq, _ = collective_sequence(jaxpr)
+    report.intrinsic += lint_collective_jaxpr(jaxpr, "bucketed_twolevel")
     return report
 
 
@@ -483,6 +618,7 @@ ENTRIES = {
         lambda shape: _solo_report(shape, "sparse"),
     "bucketed_cutover":
         lambda shape: _solo_report(shape, "sparse", cutover=True),
+    "bucketed_twolevel": _twolevel_report,
     "batched_fused":
         lambda shape: _batched_report(shape, "fused"),
     "batched_bucketed":

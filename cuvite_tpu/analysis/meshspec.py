@@ -87,6 +87,16 @@ _SEGMENT_PREFIX = "segment_"
 
 _REPL_OK_RE = re.compile(r"#\s*graftlint:\s*replicated-ok\s*=\s*(.+?)\s*$")
 
+# Optional machine-readable scope prefix on a replicated-ok reason:
+# ``scope=ici; <prose>`` declares the buffer's replication extent.
+# ``ici`` = the table is materialized only inside the fast submesh (a
+# flat mesh is the degenerate single-ICI-group case — the replicated/
+# sort exchanges are rejected on hybrid meshes, so their gather axis
+# never spans more than one ICI group); ``scalar`` = not vertex-scaled
+# (O(nshards) bytes).  A reason with no prefix reads as scope=global —
+# the two-level inventory contract is that NO site keeps that scope.
+_SCOPE_RE = re.compile(r"^scope=([A-Za-z0-9_]+)\s*;\s*")
+
 
 def _last(name: str | None) -> str:
     return name.split(".")[-1] if name else ""
@@ -557,19 +567,25 @@ class MeshProject:
 
 def replicated_inventory(summaries) -> list:
     """Every annotated O(nv_total) materialization in the summary set:
-    [{rel, line, fn, call, size, reason, snippet}] — the closed,
-    justified inventory of per-chip-replicated tables ROADMAP item 5
-    starts from (``python tools/mesh_audit.py --inventory`` prints
-    it)."""
+    [{rel, line, fn, call, size, scope, reason, snippet}] — the closed,
+    justified inventory of per-chip-replicated tables the two-level
+    exchange narrowed (``python tools/mesh_audit.py --inventory``
+    prints it).  ``scope`` is parsed from the reason's ``scope=<s>;``
+    prefix (see :data:`_SCOPE_RE`); an unprefixed reason reports
+    ``"global"`` — the scope the two-level contract eliminated, kept
+    visible so a regression is one grep away."""
     out = []
     for s in summaries:
         mesh = (s or {}).get("mesh") or {}
         for a in mesh.get("allocs", ()):
             if a.get("replicated_ok"):
+                reason = a["replicated_ok"]
+                m = _SCOPE_RE.match(reason)
                 out.append({
                     "rel": s["rel"], "line": a["line"], "fn": a["fn"],
                     "call": a["call"], "size": a["size"],
-                    "reason": a["replicated_ok"],
+                    "scope": m.group(1) if m else "global",
+                    "reason": reason[m.end():] if m else reason,
                     "snippet": a["snippet"],
                 })
     return sorted(out, key=lambda d: (d["rel"], d["line"]))

@@ -89,6 +89,13 @@ def build_parser() -> argparse.ArgumentParser:
     run = p.add_argument_group("clustering")
     run.add_argument("--shards", type=int, default=1,
                      help="number of mesh devices (vertex shards)")
+    run.add_argument("--mesh", metavar="DCNxICI",
+                     help="2-D hybrid mesh 'dcn x ici' (e.g. 2x4) for the "
+                          "two-level exchange: community tables replicate "
+                          "only inside each fast ICI group, cross-group "
+                          "traffic rides the sparse ghost protocol on the "
+                          "slow DCN axis; 1xN is bit-compatible with "
+                          "--shards N (auto = flat when dcn == 1)")
     run.add_argument("--balanced", "-b", action="store_true",
                      help="edge-balanced partition")
     run.add_argument("--threshold", type=float, default=1e-6)
@@ -105,11 +112,13 @@ def build_parser() -> argparse.ArgumentParser:
                      choices=["auto", "sort", "bucketed", "pallas", "fused"],
                      help="execution engine (auto = degree-bucketed)")
     run.add_argument("--exchange", default="auto",
-                     choices=["auto", "sparse", "replicated"],
+                     choices=["auto", "sparse", "replicated", "twolevel"],
                      help="SPMD community exchange: 'sparse' = per-phase "
                           "ghost routing, O(owned+ghosts)/iteration (the "
                           "fillRemoteCommunities analog); 'replicated' = "
-                          "all_gather of the full community vector; 'auto' "
+                          "all_gather of the full community vector; "
+                          "'twolevel' = ICI-group tables + DCN ghost "
+                          "routing (requires --mesh with dcn > 1); 'auto' "
                           "picks by graph size per phase")
     run.add_argument("--checkpoint-dir", metavar="DIR",
                      help="save inter-phase state after each phase "
@@ -170,6 +179,36 @@ def validate(args) -> None:
         raise SystemExit("--et-delta must be in [0, 1]")
     if args.resume and not args.checkpoint_dir:
         raise SystemExit("--resume requires --checkpoint-dir")
+    if args.mesh:
+        try:
+            d, _, i = args.mesh.lower().replace("×", "x").partition("x")
+            dcn, ici = int(d), int(i)
+        except ValueError:
+            raise SystemExit(f"--mesh must be DCNxICI (e.g. 2x4), "
+                             f"got {args.mesh!r}")
+        if dcn < 1 or ici < 1:
+            raise SystemExit("--mesh factors must be >= 1")
+        if args.shards not in (1, dcn * ici):
+            raise SystemExit(f"--shards {args.shards} conflicts with "
+                             f"--mesh {args.mesh} ({dcn * ici} devices)")
+        if dcn > 1:
+            if args.coloring or args.vertex_ordering:
+                raise SystemExit("--mesh with dcn > 1 (two-level exchange) "
+                                 "is incompatible with --coloring/"
+                                 "--vertex-ordering")
+            if args.engine in ("sort", "fused"):
+                raise SystemExit("--mesh with dcn > 1 requires the "
+                                 "bucketed/pallas engines")
+            if args.dist_ingest:
+                raise SystemExit("--mesh with dcn > 1 does not support "
+                                 "--dist-ingest yet")
+            if args.exchange == "replicated":
+                raise SystemExit("--mesh with dcn > 1 runs the two-level "
+                                 "exchange; --exchange replicated needs a "
+                                 "flat mesh")
+    elif args.exchange == "twolevel":
+        raise SystemExit("--exchange twolevel requires --mesh DCNxICI "
+                         "with dcn > 1")
     if args.dist_ingest:
         if not args.file:
             raise SystemExit("--dist-ingest requires --file")
@@ -293,6 +332,7 @@ def main(argv=None) -> int:
         res = louvain_phases(
             graph,
             nshards=args.shards,
+            mesh_shape=args.mesh,
             threshold=args.threshold,
             threshold_cycling=args.threshold_cycling,
             one_phase=args.one_phase,
@@ -361,6 +401,16 @@ def main(argv=None) -> int:
         "seconds": res.total_seconds,
         "teps": teps,
     }
+    if getattr(res, "exchange_stats", None):
+        # The SPMD run's exchange arm (ISSUE 18): mode plus — on a
+        # two-level run — dcn/ici and the per-device table/ghost bytes;
+        # perf_regress keeps flat and two-level records in separate arms
+        # on this block.
+        xs = res.exchange_stats
+        summary["exchange"] = {
+            k: xs[k] for k in ("mode", "dcn", "ici",
+                               "table_bytes_per_device", "ghost_bytes")
+            if k in xs}
     if args.json:
         print(json.dumps(summary))
 

@@ -66,6 +66,13 @@ class ExchangePlan:
         (peer-major) holding ghost g's value; ghosts are sorted by global id,
         hence grouped by owner, so the selection is a pure permutation.
     ``ghost_ids[s]`` — sorted global (padded-space) ids of shard s's ghosts.
+
+    Two-level mode (:meth:`build_grouped`): the "shards" of the plan are
+    DCN GROUPS of ``ici`` consecutive device shards each — ``nv_pad`` is
+    then the GROUP window ``ici * shard_nv_pad``, routing runs on the
+    slow outer axis only, and ghosts are vertices referenced outside the
+    whole group (intra-group references are satisfied by the ICI-local
+    all_gather instead).
     """
 
     nshards: int
@@ -76,6 +83,8 @@ class ExchangePlan:
     ghost_sel: np.ndarray      # [S, G] int32
     ghost_ids: list            # list[np.ndarray] per shard
     max_ghosts: int
+    ici: int = 1               # device shards per plan shard (dcn group)
+    shard_nv_pad: int = 0      # per-device owned window (0 -> nv_pad)
 
     @staticmethod
     def build(dg) -> "ExchangePlan":
@@ -152,33 +161,104 @@ class ExchangePlan:
             max_ghosts=max_g,
         )
 
-    def stats(self) -> dict:
+    @staticmethod
+    def build_grouped(dg, n_dcn: int) -> "ExchangePlan":
+        """Two-level plan: route on the slow DCN axis between GROUPS of
+        ``dg.nshards // n_dcn`` consecutive shards.  Each group's window
+        is ``nv_grp = ici * nv_pad`` padded-global ids (dcn-major shard
+        order, so group g owns exactly the flat shards
+        ``[g*ici, (g+1)*ici)``); ghosts are ids referenced by ANY member
+        shard outside the group.  Intra-group references need no routing
+        — the per-iteration ICI all_gather covers them."""
+        S, nvp = dg.nshards, dg.nv_pad
+        if getattr(dg, "local_only", False):
+            raise NotImplementedError(
+                "two-level exchange does not support per-host ingest yet")
+        if n_dcn < 1 or S % n_dcn:
+            raise ValueError(
+                f"dcn={n_dcn} must divide nshards={S}")
+        ici = S // n_dcn
+        nv_grp = ici * nvp
+        ghost_ids = []
+        for g in range(n_dcn):
+            refs = []
+            for sh in dg.shards[g * ici:(g + 1) * ici]:
+                real = np.asarray(sh.src) < nvp
+                d = np.asarray(sh.dst)[real].astype(np.int64)
+                owned = (d >= g * nv_grp) & (d < (g + 1) * nv_grp)
+                refs.append(d[~owned])
+            ghost_ids.append(np.unique(np.concatenate(refs)) if refs
+                             else np.zeros(0, dtype=np.int64))
+        bounds = [np.searchsorted(gi, np.arange(n_dcn + 1) * nv_grp)
+                  for gi in ghost_ids]
+        max_g = max((len(gi) for gi in ghost_ids), default=0)
+        G = next_pow2(max(max_g, 1))
+        B = 1
+        for g in range(n_dcn):
+            if len(ghost_ids[g]):
+                B = max(B, int(np.max(np.diff(bounds[g]))))
+        B = next_pow2(B)
+        send_idx = np.full((n_dcn, n_dcn, B), nv_grp, dtype=np.int32)
+        ghost_sel = np.zeros((n_dcn, G), dtype=np.int32)
+        for g in range(n_dcn):
+            gids, bnd = ghost_ids[g], bounds[g]
+            if not len(gids):
+                continue
+            owner = gids // nv_grp
+            rank = np.arange(len(gids), dtype=np.int64) - bnd[owner]
+            ghost_sel[g, : len(gids)] = (owner * B + rank).astype(np.int32)
+            send_idx[owner, g, rank] = (gids - owner * nv_grp).astype(np.int32)
+        return ExchangePlan(
+            nshards=n_dcn, nv_pad=nv_grp, block=B, ghost_pad=G,
+            send_idx=send_idx, ghost_sel=ghost_sel, ghost_ids=ghost_ids,
+            max_ghosts=max_g, ici=ici, shard_nv_pad=nvp,
+        )
+
+    def stats(self, itemsize: int = 4) -> dict:
         """Plan-shape digest for the flight recorder's ``exchange`` event
         (obs/events.py): the numbers that decide per-iteration comm volume
         — O(S*B) sent per shard, G-table ghost reads — and the padding
-        waste (max_ghosts vs ghost_pad)."""
-        return {
+        waste (max_ghosts vs ghost_pad).  ``ghost_bytes`` is the 3-channel
+        ghost-pull payload per device per iteration; on a two-level plan
+        ``table_bytes_per_device`` is the per-device cost of the
+        ICI-gathered group tables (comm + vdeg at the GROUP window — the
+        O(nv_total / n_dcn) figure the per-axis budget law checks)."""
+        out = {
+            "mode": "twolevel" if self.ici > 1 else "sparse",
             "nshards": self.nshards,
             "block": self.block,
             "ghost_pad": self.ghost_pad,
             "max_ghosts": self.max_ghosts,
             "ghosts_per_shard": [len(g) for g in self.ghost_ids],
+            "ghost_bytes": 3 * self.nshards * self.block * itemsize,
         }
+        if self.ici > 1:
+            out["dcn"] = self.nshards
+            out["ici"] = self.ici
+            out["table_bytes_per_device"] = 2 * self.nv_pad * itemsize
+        return out
 
     def remap_dst(self, s: int, src: np.ndarray, dst: np.ndarray) -> np.ndarray:
-        """Rewrite shard s's global-padded dst ids into the shard-extended
+        """Rewrite shard s's global-padded dst ids into the (group-)extended
         local space [0, nv_pad + ghost_pad): owned -> local index, ghost ->
         nv_pad + position in the sorted ghost table (the dense-remap trick of
         the reference GPU path, /root/reference/louvain_cuda.cu:2244-2378,
-        as a phase-static host transform).  Padding edges map to 0."""
+        as a phase-static host transform).  Padding edges map to 0.
+
+        On a two-level plan ``s`` is still the DEVICE shard index; its
+        group ``s // ici`` picks the window, so owned means
+        owned-by-group and the result indexes the group-extended arrays
+        every ICI sibling materializes."""
         nvp = self.nv_pad
+        svp = self.shard_nv_pad or nvp
+        g = s // self.ici
         d = dst.astype(np.int64)
         out = np.zeros(len(d), dtype=np.int64)
-        real = src < nvp
-        owned = real & (d >= s * nvp) & (d < (s + 1) * nvp)
-        out[owned] = d[owned] - s * nvp
+        real = src < svp
+        owned = real & (d >= g * nvp) & (d < (g + 1) * nvp)
+        out[owned] = d[owned] - g * nvp
         ghost = real & ~owned
-        out[ghost] = nvp + np.searchsorted(self.ghost_ids[s], d[ghost])
+        out[ghost] = nvp + np.searchsorted(self.ghost_ids[g], d[ghost])
         return out
 
 
@@ -414,19 +494,64 @@ def sparse_env(comm, vdeg, send_idx, ghost_sel, axis_name, *,
     )
 
 
-def sparse_modularity(counter0, deg_local, constant, axis_name, accum_dtype):
+def twolevel_env(comm, vdeg, send_idx, ghost_sel, dcn_axis, ici_axis, *,
+                 n_dcn: int, budget: int, info=None) -> SparseEnv:
+    """Two-level community state: tables at GROUP scale, routed on DCN.
+
+    ``comm``/``vdeg`` are the device shard's owned slices [nv_pad].  The
+    ICI all_gather materializes the group window [nv_grp = ici * nv_pad]
+    — the only O(nv)-scale replication left, and it is 1/n_dcn of the
+    flat exchange's — after which the UNCHANGED sparse protocol runs at
+    group scale on the slow axis: every ICI sibling holds identical
+    group vectors, so the redundant per-column DCN collectives all
+    compute the same bits (correctness by replication; the bandwidth
+    overlap is accepted — the DCN payload is the small O(ghosts) one).
+
+    Returns a :class:`SparseEnv` whose ``*_ext`` arrays are GROUP-
+    extended [nv_grp + G] (edge dst ids are remapped to that space by
+    :meth:`ExchangePlan.remap_dst`), ``cdeg_v``/``csize_v`` are sliced
+    back to the device's own [nv_pad] window, and ``deg_local`` stays at
+    group scale (ICI-replicated, each community counted once per group —
+    feed ``deg_axis_name=dcn_axis`` to :func:`sparse_modularity`)."""
+    nv_pad = comm.shape[0]
+    comm_grp = jax.lax.all_gather(  # graftlint: replicated-ok=scope=ici; group community vector gathered only inside the fast submesh — O(nv_total/n_dcn) per device, the two-level contract M003 budgets
+        comm, ici_axis, tiled=True)
+    vdeg_grp = jax.lax.all_gather(  # graftlint: replicated-ok=scope=ici; group vertex-degree vector, same 1/n_dcn window as the community gather above
+        vdeg, ici_axis, tiled=True)
+    info_grp = None
+    if info is not None:
+        info_grp = jax.lax.all_gather(  # graftlint: replicated-ok=scope=ici; frozen-assignment (vertex-ordering) group vector, same 1/n_dcn window
+            info, ici_axis, tiled=True)
+    env = sparse_env(comm_grp, vdeg_grp, send_idx, ghost_sel, dcn_axis,
+                     nshards=n_dcn, budget=budget, info=info_grp)
+    off = jax.lax.axis_index(ici_axis) * nv_pad
+    return env._replace(
+        cdeg_v=jax.lax.dynamic_slice(env.cdeg_v, (off,), (nv_pad,)),
+        csize_v=jax.lax.dynamic_slice(env.csize_v, (off,), (nv_pad,)),
+    )
+
+
+def sparse_modularity(counter0, deg_local, constant, axis_name, accum_dtype,
+                      deg_axis_name=None):
     """Q = e·c - a²·c² with comm_deg sharded by owner: the a² term sums each
     shard's OWNED community degrees (every community counted exactly once)
     and psums — per-chip work O(nv_local), not O(nv_total).
 
+    ``deg_axis_name`` narrows the a²-term reduction axis when
+    ``deg_local`` is replicated along part of the mesh: under the
+    two-level exchange it is group-scale and ICI-replicated, so summing
+    over the DCN axis only counts each community exactly once while the
+    per-edge e-term still reduces over the full ``axis_name``.
+
     ``accum_dtype=segment.DS_ACCUM`` runs both reductions in double-single
     f32 pairs with an exact cross-shard pair reduce (see modularity_terms)."""
+    deg_axis = axis_name if deg_axis_name is None else deg_axis_name
     if accum_dtype == seg.DS_ACCUM:
         from cuvite_tpu.ops import exactsum as ds
 
         le = ds.ds_psum(ds.ds_tree_sum(counter0), axis_name)
         p, e = ds.two_prod(deg_local, deg_local)
-        la2 = ds.ds_psum(ds.ds_tree_sum(p, e), axis_name)
+        la2 = ds.ds_psum(ds.ds_tree_sum(p, e), deg_axis)
         c = ds.ds_from_f32(constant)
         q = ds.ds_add(ds.ds_mul(le, c),
                       ds.ds_neg(ds.ds_mul(la2, ds.ds_mul(c, c))))
@@ -434,6 +559,6 @@ def sparse_modularity(counter0, deg_local, constant, axis_name, accum_dtype):
     acc = counter0.dtype if accum_dtype is None else accum_dtype
     le_xx = jax.lax.psum(jnp.sum(counter0.astype(acc)), axis_name)
     la2_x = jax.lax.psum(jnp.sum(jnp.square(deg_local.astype(acc))),
-                         axis_name)
+                         deg_axis)
     c_acc = constant.astype(acc)
     return le_xx * c_acc - la2_x * c_acc * c_acc

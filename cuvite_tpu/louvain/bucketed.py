@@ -403,6 +403,12 @@ def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
         # send_idx/ghost_sel and extended-local dst space — only the row
         # masking differs.  remap_dst sees the MASKED src, so masked-out
         # edges map to dst 0 and are dropped as padding.
+        # Grouped (two-level) plans remap dst into GROUP-local space, so
+        # shard s's self edge lands at (s % ici)*nvl + src, not src: the
+        # base is the shard's offset within its dcn group (0 for flat
+        # plans, where ici == 1 and the two formulations coincide).
+        grp_ici = getattr(exchange_plan, "ici", 1) or 1
+
         def _sparse_plan(s):
             ms = _mask_src(s)   # one O(E) masking pass, shared
             return BucketPlan.build(
@@ -411,7 +417,7 @@ def build_stacked_plans(dg, widths: tuple = DEFAULT_BUCKETS,
                     s, ms, np.asarray(dg.shards[s].dst)
                 ).astype(np.asarray(dg.shards[s].dst).dtype),
                 np.asarray(dg.shards[s].w),
-                nv_local=nvl, base=0, widths=widths,
+                nv_local=nvl, base=(s % grp_ici) * nvl, widths=widths,
             )
 
         plans = [_sparse_plan(s) for s in sids]
@@ -759,7 +765,7 @@ def _rows_chunked(w_mat, dst_mat, curr, vdeg_v, sl_v, ax_v,
 def bucketed_modularity(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
                         constant, *, nv_total, accum_dtype=None,
                         axis_name=None, sparse_plan=None, nshards=1,
-                        budget=0):
+                        budget=0, ici_axis=None):
     """Modularity of ``comm`` alone (no argmax): one cheap masked-sum pass
     over the bucket rows + heavy slab.  Used by the color-scheduled
     iteration, whose per-class steps see partial states — this gives the
@@ -770,20 +776,31 @@ def bucketed_modularity(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
     With ``sparse_plan`` the pass rides the sparse ghost exchange instead
     (dst ids extended-local, owner-sharded a² term) and RETURNS
     ``(modularity, overflow)`` — the budgeted owner-reduce behind the a²
-    term can overflow exactly like the step's."""
+    term can overflow exactly like the step's.  ``ici_axis`` upgrades the
+    sparse exchange to the two-level scheme: ``axis_name`` is then the
+    slow DCN axis, the plan a grouped one, and the per-edge terms reduce
+    over BOTH axes while the a² term stays on the DCN axis only (the
+    group tables are ICI-replicated)."""
     nv_local = comm.shape[0]
     wdt = vdeg.dtype
     use_sparse = sparse_plan is not None
+    red_axes = (axis_name if ici_axis is None else (axis_name, ici_axis))
     if use_sparse:
-        from cuvite_tpu.comm.exchange import sparse_env, sparse_modularity
+        from cuvite_tpu.comm.exchange import (
+            sparse_env, sparse_modularity, twolevel_env)
 
         assert axis_name is not None, "sparse exchange requires a mesh axis"
-        env = sparse_env(comm, vdeg, sparse_plan[0], sparse_plan[1],
-                         axis_name, nshards=nshards, budget=budget)
+        if ici_axis is not None:
+            env = twolevel_env(comm, vdeg, sparse_plan[0], sparse_plan[1],
+                               axis_name, ici_axis, n_dcn=nshards,
+                               budget=budget)
+        else:
+            env = sparse_env(comm, vdeg, sparse_plan[0], sparse_plan[1],
+                             axis_name, nshards=nshards, budget=budget)
         comm_full = env.comm_ext
     else:
         comm_full, gsum = seg.spmd_env(comm, axis_name)
-        comm_deg = gsum(seg.segment_sum(vdeg, comm, num_segments=nv_total))  # graftlint: replicated-ok=replicated-exchange mod pass; the sparse branch above avoids the table
+        comm_deg = gsum(seg.segment_sum(vdeg, comm, num_segments=nv_total))  # graftlint: replicated-ok=scope=ici; replicated-exchange mod pass, flat-mesh-only (hybrid meshes take the sparse/two-level branch above)
     counter0 = jnp.zeros((nv_local,), dtype=wdt)
     hs, hd, hw = heavy_arrays
     ckey_h = jnp.take(comm_full, hd)
@@ -813,9 +830,10 @@ def bucketed_modularity(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
         counter0 = counter0.at[verts].add(c0_rows, mode="drop")
     if use_sparse:
         mod = sparse_modularity(counter0, env.deg_local, constant,
-                                axis_name, accum_dtype)
+                                red_axes, accum_dtype,
+                                deg_axis_name=axis_name)
         overflow = jax.lax.psum(env.overflow.astype(jnp.int32),
-                                axis_name) > 0
+                                red_axes) > 0
         return mod, overflow
     return seg.modularity_terms(counter0, comm_deg, constant,
                                 gsum, accum_dtype, axis_name=axis_name)
@@ -824,8 +842,8 @@ def bucketed_modularity(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
 def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
                   constant, *, nv_total, sentinel, accum_dtype=None,
                   axis_name=None, pallas_flags=(), pallas_interpret=False,
-                  sparse_plan=None, nshards=1, budget=0, info_comm=None,
-                  assemble_perm=None, heavy_kernel=None):
+                  sparse_plan=None, nshards=1, budget=0, ici_axis=None,
+                  info_comm=None, assemble_perm=None, heavy_kernel=None):
     """Full Louvain sweep over one shard using the bucketed engine.
 
     ``assemble_perm`` (phase-static [nv_local] int32, vertex -> index into
@@ -865,6 +883,13 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
       community degree/size ride the phase-static ghost routing, community
       info is sharded by owner and resolved through the budgeted
       owner-reduce (cuvite_tpu/comm/exchange.py) — O(owned + ghosts).
+    - two-level (``sparse_plan`` + ``ici_axis``, ISSUE 18): ``axis_name``
+      is the slow DCN axis of a 2-D hybrid mesh, the plan a GROUPED one
+      (``ExchangePlan.build_grouped``); community state is gathered to
+      group scale on the fast ICI axis — O(nv_total / n_dcn) per chip —
+      and the sparse protocol runs between groups on the DCN axis.
+      Scalars reduce over both axes; the a² modularity term over DCN
+      only (the group tables are ICI-replicated).
 
     ``info_comm``: optional FROZEN assignment used only for the community
     degree/size tables — the vertex-ordering schedule (reference -d,
@@ -887,26 +912,33 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
     vdt = comm.dtype
 
     use_sparse = sparse_plan is not None
+    red_axes = (axis_name if ici_axis is None else (axis_name, ici_axis))
     if use_sparse:
-        from cuvite_tpu.comm.exchange import sparse_env, sparse_modularity
+        from cuvite_tpu.comm.exchange import (
+            sparse_env, sparse_modularity, twolevel_env)
 
         assert axis_name is not None, "sparse exchange requires a mesh axis"
-        env = sparse_env(comm, vdeg, sparse_plan[0], sparse_plan[1],
-                         axis_name, nshards=nshards, budget=budget,
-                         info=info_comm)
+        if ici_axis is not None:
+            env = twolevel_env(comm, vdeg, sparse_plan[0], sparse_plan[1],
+                               axis_name, ici_axis, n_dcn=nshards,
+                               budget=budget, info=info_comm)
+        else:
+            env = sparse_env(comm, vdeg, sparse_plan[0], sparse_plan[1],
+                             axis_name, nshards=nshards, budget=budget,
+                             info=info_comm)
         comm_ref = env.comm_ext      # gather table for dst indices
 
         def gsum(x):
-            return jax.lax.psum(x, axis_name)
+            return jax.lax.psum(x, red_axes)
 
         overflow = jax.lax.psum(env.overflow.astype(jnp.int32),
-                                axis_name) > 0
+                                red_axes) > 0
     else:
         env = None
         comm_ref, gsum = seg.spmd_env(comm, axis_name)
         info = comm if info_comm is None else info_comm
-        comm_deg = gsum(seg.segment_sum(vdeg, info, num_segments=nv_total))  # graftlint: replicated-ok=replicated-exchange community degree table; sparse mode (the cutover fix) rides the ghost plan instead
-        comm_size = gsum(seg.segment_sum(  # graftlint: replicated-ok=replicated-exchange community size table; sparse mode attaches sizes to ghosts instead
+        comm_deg = gsum(seg.segment_sum(vdeg, info, num_segments=nv_total))  # graftlint: replicated-ok=scope=ici; replicated-exchange community degree table, flat-mesh-only (one ICI group); sparse/two-level modes ride the ghost plan instead
+        comm_size = gsum(seg.segment_sum(  # graftlint: replicated-ok=scope=ici; replicated-exchange community size table, flat-mesh-only (one ICI group); sparse/two-level modes attach sizes to ghosts instead
             jnp.ones((nv_local,), dtype=vdt), info, num_segments=nv_total
         ))
         overflow = jnp.zeros((), dtype=bool)  # replicated: can't overflow
@@ -1112,7 +1144,8 @@ def bucketed_step(bucket_arrays, heavy_arrays, self_loop, comm, vdeg,
 
     if use_sparse:
         modularity = sparse_modularity(counter0, env.deg_local, constant,
-                                       axis_name, accum_dtype)
+                                       red_axes, accum_dtype,
+                                       deg_axis_name=axis_name)
     else:
         modularity = seg.modularity_terms(counter0, comm_deg, constant, gsum,
                                           accum_dtype, axis_name=axis_name)
@@ -1175,16 +1208,19 @@ def make_sharded_class_step(mesh, axis_name: str, n_buckets: int,
 
 
 def make_sharded_bucketed_mod(mesh, axis_name: str, n_buckets: int,
-                              nv_total: int, accum_dtype=None, sparse=None):
+                              nv_total: int, accum_dtype=None, sparse=None,
+                              ici_axis=None):
     """Jit the counter0-only modularity pass as a shard_map (the SPMD
     convergence check for the class-scheduled iteration).  With
     ``sparse=(nshards, budget)`` it rides the sparse exchange and returns
-    ``(modularity, overflow)``."""
-    bspec = tuple((P(axis_name), P(axis_name), P(axis_name))
-                  for _ in range(n_buckets))
-    hspec = (P(axis_name), P(axis_name), P(axis_name))
-    in_specs = [bspec, hspec, P(axis_name), P(axis_name), P(axis_name),
-                P()]
+    ``(modularity, overflow)``.  ``ici_axis`` (with ``sparse``) selects
+    the two-level exchange on a hybrid mesh: vertex state shards over
+    both axes, the grouped plan over the DCN axis only (each ICI sibling
+    reads its whole group's routing rows)."""
+    vspec = P(axis_name) if ici_axis is None else P((axis_name, ici_axis))
+    bspec = tuple((vspec, vspec, vspec) for _ in range(n_buckets))
+    hspec = (vspec, vspec, vspec)
+    in_specs = [bspec, hspec, vspec, vspec, vspec, P()]
     if sparse is not None:
         nshards, budget = sparse
         in_specs += [P(axis_name), P(axis_name)]
@@ -1206,7 +1242,7 @@ def make_sharded_bucketed_mod(mesh, axis_name: str, n_buckets: int,
             bucket_arrays, heavy_arrays, self_loop, comm, vdeg, constant,
             nv_total=nv_total, accum_dtype=accum_dtype, axis_name=axis_name,
             sparse_plan=plan if plan else None,
-            nshards=nshards, budget=budget,
+            nshards=nshards, budget=budget, ici_axis=ici_axis,
         )
 
     return jax.jit(mod)
@@ -1215,7 +1251,8 @@ def make_sharded_bucketed_mod(mesh, axis_name: str, n_buckets: int,
 def make_sharded_bucketed_step(mesh, axis_name: str, n_buckets: int,
                                nv_total: int, sentinel: int,
                                accum_dtype=None, sparse=None,
-                               pallas_flags=(), pallas_interpret=False):
+                               pallas_flags=(), pallas_interpret=False,
+                               ici_axis=None):
     """Jit the bucketed sweep as a shard_map over ``axis_name``: bucket
     matrices, heavy slab and vertex state sharded along axis 0, modularity
     and move count replicated.
@@ -1231,13 +1268,20 @@ def make_sharded_bucketed_step(mesh, axis_name: str, n_buckets: int,
     dst/w matrices must be placed TRANSPOSED [S*D, Nb] (still sharded
     along axis 0, so each shard's block is the kernel's [D, Nb] layout);
     see StackedPlan.pallas_flags.  ``pallas_interpret`` runs the kernel in
-    interpret mode (non-TPU backends)."""
-    bspec = tuple((P(axis_name), P(axis_name), P(axis_name))
-                  for _ in range(n_buckets))
-    hspec = (P(axis_name), P(axis_name), P(axis_name))
-    in_specs = [bspec, hspec, P(axis_name), P(axis_name), P(axis_name), P(),
-                P(axis_name)]
-    out_specs = (P(axis_name), P(), P(), P())
+    interpret mode (non-TPU backends).
+
+    ``ici_axis`` (with ``sparse``): the two-level exchange over a hybrid
+    ``(axis_name, ici_axis)`` mesh — ``axis_name`` is then the slow DCN
+    axis, ``sparse=(n_dcn, budget)`` carries the GROUP count, vertex
+    state shards over both axes (dcn-major, identical per-device blocks
+    to the flat mesh), and the grouped plan arrays shard over the DCN
+    axis only so every ICI sibling drives the same group-scale
+    protocol."""
+    vspec = P(axis_name) if ici_axis is None else P((axis_name, ici_axis))
+    bspec = tuple((vspec, vspec, vspec) for _ in range(n_buckets))
+    hspec = (vspec, vspec, vspec)
+    in_specs = [bspec, hspec, vspec, vspec, vspec, P(), vspec]
+    out_specs = (vspec, P(), P(), P())
     if sparse is not None:
         nshards, budget = sparse
         in_specs += [P(axis_name), P(axis_name)]
@@ -1259,7 +1303,7 @@ def make_sharded_bucketed_step(mesh, axis_name: str, n_buckets: int,
             axis_name=axis_name,
             pallas_flags=pallas_flags, pallas_interpret=pallas_interpret,
             sparse_plan=plan if plan else None,
-            nshards=nshards, budget=budget,
+            nshards=nshards, budget=budget, ici_axis=ici_axis,
             assemble_perm=perm,
         )
 

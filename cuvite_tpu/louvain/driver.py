@@ -108,6 +108,10 @@ class LouvainResult:
     # ``gained=False``; the fused engine records gaining phases only).
     # None when the run predates telemetry (e.g. deserialized results).
     convergence: list | None = None
+    # Phase-1 ExchangePlan.stats() of an SPMD run (ISSUE 18): mode plus
+    # — on a two-level run — dcn/ici and the per-device table/ghost
+    # bytes.  None on single-shard runs and other engines' paths.
+    exchange_stats: dict | None = None
 
     @property
     def num_communities(self) -> int:
@@ -487,8 +491,23 @@ class PhaseRunner:
             raise ValueError(f"unknown engine {engine!r}; use 'sort', "
                              "'bucketed' or 'pallas' ('auto' is resolved "
                              "by louvain_phases)")
-        if exchange not in ("sparse", "replicated"):
+        if exchange not in ("sparse", "replicated", "twolevel"):
             raise ValueError(f"unknown exchange {exchange!r}")
+        if exchange == "twolevel":
+            from cuvite_tpu.comm.mesh import DCN_AXIS, ICI_AXIS
+
+            if mesh is None or mesh.axis_names != (DCN_AXIS, ICI_AXIS):
+                raise ValueError(
+                    "exchange='twolevel' needs a 2-D hybrid mesh "
+                    "(comm.mesh.make_hybrid_mesh)")
+            if engine not in ("bucketed", "pallas"):
+                raise ValueError(
+                    "exchange='twolevel' runs on the bucketed/pallas "
+                    "engines only")
+            if color_local is not None and n_color_classes > 0:
+                raise ValueError(
+                    "exchange='twolevel' does not support the coloring/"
+                    "ordering schedules yet (use exchange='sparse')")
         self.dg = dg
         self.mesh = mesh
         self.engine = engine
@@ -537,7 +556,8 @@ class PhaseRunner:
             # layout there — exactly the single-shard pallas contract,
             # where class plans are XLA too.
             sentinel = int(np.iinfo(vdt).max)
-            use_sparse = exchange == "sparse"
+            use_twolevel = exchange == "twolevel"
+            use_sparse = exchange in ("sparse", "twolevel")
             use_pallas = (engine == "pallas"
                           and not (color_local is not None
                                    and n_color_classes > 0))
@@ -568,11 +588,48 @@ class PhaseRunner:
                     rows = (arr.shape[0] // S_rows) * S
                     return place_block(mesh, arr, rows, P(VERTEX_AXIS))
 
-            if use_sparse:
+            if use_twolevel:
+                # Two-level (ISSUE 18): grouped plan routed on the DCN
+                # axis, community tables gathered to group scale on the
+                # ICI axis.  Plan arrays shard over DCN only — each ICI
+                # sibling holds its whole group's routing rows.
+                from cuvite_tpu.comm.exchange import ExchangePlan
+                from cuvite_tpu.comm.mesh import (
+                    DCN_AXIS, ICI_AXIS, hybrid_shape, shard_outer)
+
+                n_dcn, n_ici = hybrid_shape(mesh)
+                xplan = ExchangePlan.build_grouped(dg, n_dcn)
+                self.xplan_stats = xplan.stats(
+                    itemsize=np.dtype(vdt).itemsize)
+                self.ghost_counts = self.xplan_stats["ghosts_per_shard"]
+                if budget is None:
+                    budget = max(128, xplan.nv_pad // 4)
+                budget = min(int(budget), xplan.nv_pad)
+                self.budget = budget
+                plan = build_stacked_plans(dg, exchange_plan=xplan,
+                                           pallas_widths=pallas_widths,
+                                           count_width_edges=use_pallas)
+                with tracer.stage("upload"):
+                    self._send_idx = shard_outer(mesh, xplan.send_idx.reshape(
+                        n_dcn * n_dcn, xplan.block))
+                    self._ghost_sel = shard_outer(
+                        mesh, xplan.ghost_sel.reshape(
+                            n_dcn * xplan.ghost_pad))
+                sparse_cfg = (n_dcn, budget)
+                # The (dcn, ici) factorization is part of the program —
+                # every hybrid shape of one device pool shares the same
+                # device-id tuple, so the ids alone would alias steps
+                # compiled for different groupings.
+                key = ("bucketed-twolevel", (n_dcn, n_ici),
+                       tuple(d.id for d in mesh.devices.flat),
+                       len(plan.buckets), nv_total, sentinel, adt_np,
+                       budget, plan.pallas_flags, interp)
+            elif use_sparse:
                 from cuvite_tpu.comm.exchange import ExchangePlan
 
                 xplan = ExchangePlan.build(dg)
-                self.xplan_stats = xplan.stats()
+                self.xplan_stats = xplan.stats(
+                    itemsize=np.dtype(vdt).itemsize)
                 self.ghost_counts = self.xplan_stats["ghosts_per_shard"]
                 if budget is None:
                     budget = max(128, dg.nv_pad // 4)
@@ -641,11 +698,21 @@ class PhaseRunner:
                      if plan.width_edges[-1] else []))
             step_fn = _STEP_CACHE.get(key)
             if step_fn is None:
-                step_fn = make_sharded_bucketed_step(
-                    mesh, VERTEX_AXIS, len(buckets), nv_total, sentinel,
-                    accum_dtype=adt_np, sparse=sparse_cfg,
-                    pallas_flags=flags, pallas_interpret=interp,
-                )
+                if use_twolevel:
+                    from cuvite_tpu.comm.mesh import DCN_AXIS, ICI_AXIS
+
+                    step_fn = make_sharded_bucketed_step(
+                        mesh, DCN_AXIS, len(buckets), nv_total, sentinel,
+                        accum_dtype=adt_np, sparse=sparse_cfg,
+                        pallas_flags=flags, pallas_interpret=interp,
+                        ici_axis=ICI_AXIS,
+                    )
+                else:
+                    step_fn = make_sharded_bucketed_step(
+                        mesh, VERTEX_AXIS, len(buckets), nv_total, sentinel,
+                        accum_dtype=adt_np, sparse=sparse_cfg,
+                        pallas_flags=flags, pallas_interpret=interp,
+                    )
                 _STEP_CACHE[key] = step_fn
 
             plan_args = ((self._send_idx, self._ghost_sel) if use_sparse
@@ -970,10 +1037,16 @@ class PhaseRunner:
         if self._bucket_extra is not None:
             # Layout: (buckets, heavy, self_loop, perm[, send_idx,
             # ghost_sel]) — the tail beyond the perm is the sparse
-            # exchange routing.
+            # exchange routing.  The grouped (two-level) routing shards
+            # over dcn only — every ici sibling holds its group's rows
+            # by design — so it books under its own per-axis category
+            # (law 'ici_replicated'), not the 1/S-sharded 'exchange'.
             tracer.track("plans", *jax.tree_util.tree_leaves(
                 self._bucket_extra[:4]))
-            tracer.track("exchange", *jax.tree_util.tree_leaves(
+            xcat = ("exchange_grouped"
+                    if (self.xplan_stats or {}).get("mode") == "twolevel"
+                    else "exchange")
+            tracer.track(xcat, *jax.tree_util.tree_leaves(
                 self._bucket_extra[4:]))
         if self._class_plans is not None:
             tracer.track("plans", *jax.tree_util.tree_leaves(
@@ -1602,6 +1675,7 @@ def louvain_phases(
     graph: Graph,
     nshards: int = 1,
     mesh=None,
+    mesh_shape=None,
     threshold: float = 1.0e-6,
     threshold_cycling: bool = False,
     one_phase: bool = False,
@@ -1640,7 +1714,17 @@ def louvain_phases(
     iteration start — colors only order the sweep, exchanges hoisted out of
     the color loop (louvain.cpp:1535-1562).  Ordering is implemented on the
     single-shard bucketed engine; other engines fall back to the plain
-    schedule."""
+    schedule.
+
+    ``mesh_shape`` (ISSUE 18): ``(dcn, ici)`` tuple or ``"DxI"`` string
+    selecting a 2-D hybrid mesh for the two-level exchange — community
+    tables replicate only inside each ICI group (O(nv_total / dcn) per
+    chip), cross-group traffic rides the sparse ghost protocol on the
+    slow DCN axis.  ``dcn == 1`` is bit-compatible with the flat 1-D
+    mesh of ``nshards = ici`` (auto = flat); ``dcn > 1`` forces
+    ``exchange='twolevel'`` on every phase (the hybrid axes admit no
+    other SPMD program) and is restricted to the bucketed/pallas
+    engines with the plain schedule."""
     dist_ingest = getattr(graph, "local_only", False)
     if dist_ingest:
         # Per-host sharded ingest (io/dist_ingest.DistVite): phase 0 runs on
@@ -1668,6 +1752,55 @@ def louvain_phases(
         # An explicit per-peer budget only means anything on the sparse
         # plan; honor the caller's intent rather than silently ignoring it.
         exchange = "sparse"
+    # ---- hybrid-mesh selection (two-level exchange, ISSUE 18) -------------
+    from cuvite_tpu.comm.mesh import DCN_AXIS, ICI_AXIS
+
+    n_dcn = 1
+    if mesh_shape is not None:
+        if isinstance(mesh_shape, str):
+            d_s, _, i_s = mesh_shape.lower().replace(
+                "×", "x").partition("x")
+            mesh_shape = (int(d_s), int(i_s))
+        n_dcn, n_ici = int(mesh_shape[0]), int(mesh_shape[1])
+        if n_dcn < 1 or n_ici < 1:
+            raise ValueError(f"mesh_shape factors must be >= 1, "
+                             f"got {n_dcn}x{n_ici}")
+        if nshards not in (1, n_dcn * n_ici):
+            raise ValueError(
+                f"nshards={nshards} conflicts with mesh_shape "
+                f"{n_dcn}x{n_ici} ({n_dcn * n_ici} devices)")
+        nshards = n_dcn * n_ici
+        if n_dcn > 1:
+            if dist_ingest:
+                raise ValueError("the two-level exchange does not support "
+                                 "per-host ingest yet")
+            if coloring or vertex_ordering:
+                raise ValueError(
+                    "the two-level exchange does not support coloring/"
+                    "vertex-ordering yet (use a flat mesh)")
+            if engine not in ("auto", "bucketed", "pallas"):
+                raise ValueError("the two-level exchange runs on the "
+                                 "bucketed/pallas engines only")
+            if mesh is None:
+                from cuvite_tpu.comm.mesh import make_hybrid_mesh
+
+                mesh = make_hybrid_mesh(n_dcn, n_ici)
+        # dcn == 1: auto = flat — fall through to make_mesh(nshards),
+        # bit-compatible with today's 1-D paths.
+    elif mesh is not None and mesh.axis_names == (DCN_AXIS, ICI_AXIS):
+        n_dcn = int(mesh.devices.shape[0])
+        nshards = int(np.prod(mesh.devices.shape))
+    if exchange == "twolevel" and n_dcn <= 1:
+        raise ValueError("exchange='twolevel' requires a hybrid mesh with "
+                         "|dcn| > 1 (pass mesh_shape=(dcn, ici))")
+    if n_dcn > 1:
+        if exchange == "replicated":
+            raise ValueError("a hybrid mesh runs the two-level exchange; "
+                             "exchange='replicated' needs a flat mesh")
+        # auto/sparse on hybrid axes resolve to the only SPMD program the
+        # 2-D mesh admits; the grouped plan IS the sparse protocol at
+        # group scale, so 'sparse' intent is honored, not overridden.
+        exchange = "twolevel"
     if mesh is None and (nshards > 1 or dist_ingest):
         mesh = make_mesh(nshards)
     if engine == "auto":
@@ -1747,6 +1880,11 @@ def louvain_phases(
     # across phases (coarse phases sweep less mass but more often).
     cov_num = cov_den = cov_pending = 0
     width_hits: dict = {}
+    # Phase-1 exchange-plan digest (ISSUE 18): the full-scale graph's
+    # per-device table/ghost bytes — the number the bench `exchange`
+    # block and perf_regress's arm matching report (coarse phases
+    # shrink and would understate it).
+    exchange_stats = None
     t_start = time.perf_counter()
     phase = 0
     g = graph
@@ -1980,7 +2118,13 @@ def louvain_phases(
                     cp, cm, it, ovf = runner.run(run_threshold, **run_kw)
                 if not ovf:
                     return cp, cm, it
-                budget = min(dg.nv_pad, max(4 * (runner.budget or 128), 512))
+                # Budget ceiling = the plan's owned window: the group
+                # window under the two-level exchange, the shard window
+                # otherwise (at the ceiling the owner-route cannot
+                # overflow, so the retry terminates).
+                cap = dg.nv_pad * (nshards // n_dcn
+                                   if phase_exchange == "twolevel" else 1)
+                budget = min(cap, max(4 * (runner.budget or 128), 512))
                 runner = None
                 if verbose:
                     print(f"sparse-exchange budget overflow; retrying phase "
@@ -1996,6 +2140,9 @@ def louvain_phases(
         tracer.event("exchange", mode=phase_exchange,
                      nshards=dg.nshards, budget=runner.budget,
                      plan=runner.xplan_stats)
+        if exchange_stats is None and multi_mesh:
+            exchange_stats = dict(runner.xplan_stats or
+                                  {"mode": phase_exchange})
         if getattr(runner, "pallas_coverage", None) is not None:
             if engine != "pallas" and cov_den == 0:
                 # Bucketed run, first kernel engagement: the phases
@@ -2238,4 +2385,5 @@ def louvain_phases(
         pallas_coverage=(cov_num / cov_den) if cov_den else None,
         pallas_width_hits=width_hits or None,
         convergence=convergence,
+        exchange_stats=exchange_stats,
     )

@@ -75,10 +75,10 @@ def louvain_step_local(
 
     # --- community info: size + weighted degree, recomputed fresh ---------
     comm_deg = gsum(
-        seg.segment_sum(vdeg_local, comm_local, num_segments=nv_total)  # graftlint: replicated-ok=replicated-exchange community degree table (sort engine has no sparse mode)
+        seg.segment_sum(vdeg_local, comm_local, num_segments=nv_total)  # graftlint: replicated-ok=scope=ici; replicated-exchange community degree table (sort engine is flat-mesh-only; a flat mesh is one ICI group)
     )
     comm_size = gsum(
-        seg.segment_sum(  # graftlint: replicated-ok=replicated-exchange community size table (sort engine has no sparse mode)
+        seg.segment_sum(  # graftlint: replicated-ok=scope=ici; replicated-exchange community size table (sort engine is flat-mesh-only; a flat mesh is one ICI group)
             jnp.ones((nv_local,), dtype=vdt), comm_local, num_segments=nv_total
         )
     )

@@ -65,7 +65,8 @@ class DeviceMemoryLedger:
     O(nv_total)-per-chip replication creep round-8 measured).
     """
 
-    CATEGORIES = ("slab", "tables", "plans", "exchange", "scratch")
+    CATEGORIES = ("slab", "tables", "plans", "exchange",
+                  "exchange_grouped", "scratch")
 
     def __init__(self):
         self.live: dict = {}

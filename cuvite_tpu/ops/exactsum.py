@@ -137,7 +137,7 @@ def ds_psum(pair, axis_name):
     launches — on the hot ds32 modularity path that is one avoidable
     collective launch per reduction.  Gathers are exact, so the packed
     form is bit-identical to the two-launch one."""
-    both = jax.lax.all_gather(jnp.stack([pair[0], pair[1]]), axis_name)  # graftlint: replicated-ok=O(nshards) scalar ds pairs, not vertex-scaled
+    both = jax.lax.all_gather(jnp.stack([pair[0], pair[1]]), axis_name)  # graftlint: replicated-ok=scope=scalar; O(nshards) ds pairs, not vertex-scaled
     return ds_tree_sum(both[:, 0], both[:, 1])
 
 

@@ -53,7 +53,7 @@ def spmd_env(comm_local, axis_name):
     degenerates to identity."""
     if axis_name is None:
         return comm_local, lambda x: x
-    comm_full = jax.lax.all_gather(comm_local, axis_name, tiled=True)  # graftlint: replicated-ok=the replicated exchange's community vector, O(nv_total) per chip by design; the sparse exchange (comm/exchange.py) is the fix past the cutover
+    comm_full = jax.lax.all_gather(comm_local, axis_name, tiled=True)  # graftlint: replicated-ok=scope=ici; the replicated exchange's community vector — flat-mesh-only (the hybrid driver rejects exchange='replicated'), so the gather never spans more than one ICI group; the sparse/two-level exchanges are the fix past the cutover
     return comm_full, lambda x: jax.lax.psum(x, axis_name)
 
 

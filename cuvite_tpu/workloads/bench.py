@@ -162,6 +162,10 @@ def validate_record(rec: dict) -> list:
         # resident slab — cold full-run wall vs warm-start delta
         # re-cluster wall, same graph, same compile guard.
         problems.extend(_validate_stream_block(rec.get("stream")))
+        # Optional `exchange` block (ISSUE 18): which SPMD exchange arm
+        # the run used — a two-level record must carry its (dcn, ici)
+        # factorization and per-device table/ghost bytes.
+        problems.extend(_validate_exchange_block(rec.get("exchange")))
     return problems
 
 
@@ -309,6 +313,42 @@ def _validate_stream_block(stream) -> list:
                                and 0.0 < cf < 1.0):
         problems.append(
             f"stream.churn_frac must be a fraction in (0, 1), got {cf!r}")
+    return problems
+
+
+# Required keys of the optional `exchange` bench block (schema v4 +
+# ISSUE 18) when the record ran the two-level exchange: dcn / ici — the
+# hybrid-mesh factorization; table_bytes_per_device — the ICI-gathered
+# group-table bytes per chip (the O(nv_total / dcn) figure the per-axis
+# replication budget checks); ghost_bytes — the per-iteration DCN ghost
+# payload.  Flat SPMD records carry only `mode`.  perf_regress treats
+# flat and two-level records as separate arms on this block: shrinking
+# the per-chip table window by |dcn| changes the exchange cost model,
+# so their TEPS never gate each other.
+REQUIRED_TWOLEVEL_KEYS = ("dcn", "ici", "table_bytes_per_device",
+                          "ghost_bytes")
+
+EXCHANGE_MODES = ("replicated", "sparse", "twolevel")
+
+
+def _validate_exchange_block(exch) -> list:
+    if exch is None:
+        return []
+    if not isinstance(exch, dict):
+        return [f"exchange must be a dict, got {type(exch).__name__}"]
+    mode = exch.get("mode")
+    if mode not in EXCHANGE_MODES:
+        return [f"exchange.mode must be one of {EXCHANGE_MODES}, "
+                f"got {mode!r}"]
+    problems = []
+    if mode == "twolevel":
+        problems += [f"a twolevel exchange block must carry {k!r}"
+                     for k in REQUIRED_TWOLEVEL_KEYS if k not in exch]
+        for k in REQUIRED_TWOLEVEL_KEYS:
+            v = exch.get(k)
+            if k in exch and (not isinstance(v, int) or v <= 0):
+                problems.append(
+                    f"exchange.{k} must be a positive int, got {v!r}")
     return problems
 
 
@@ -475,6 +515,15 @@ def run_bench(
             out["pallas_width_hits"] = {
                 str(w): int(n)
                 for w, n in sorted(res.pallas_width_hits.items())}
+        xs = getattr(res, "exchange_stats", None)
+        if xs:
+            # The run's SPMD exchange arm (ISSUE 18; validated by
+            # _validate_exchange_block — a two-level record must carry
+            # its factorization and per-device table/ghost bytes).
+            out["exchange"] = {
+                k: xs[k] for k in ("mode", "dcn", "ici",
+                                   "table_bytes_per_device",
+                                   "ghost_bytes") if k in xs}
         if not compile_guard["checked"]:
             out["compile_included"] = True
         if all_teps:

@@ -1571,6 +1571,74 @@ def test_r023_inline_suppression():
     assert not any(f.rule == "R023" for f in run_project_sources(src))
 
 
+# Hybrid 2-D ('dcn','ici') mesh project: the two-level exchange shape.
+MESH5_HYBRID_MESH = """
+import numpy as np
+from jax.sharding import Mesh
+
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
+def make_hybrid(devs, n_dcn, n_ici):
+    return Mesh(np.array(devs).reshape(n_dcn, n_ici),
+                (DCN_AXIS, ICI_AXIS))
+"""
+
+MESH5_HYBRID_STEP = """
+import jax
+from cuvite_tpu.fake_hmesh5 import DCN_AXIS, ICI_AXIS
+from cuvite_tpu.fake_htable5 import group_tables
+
+def make_step(mesh):
+    def step(comm, vdeg):
+        return group_tables(comm, vdeg, DCN_AXIS, ICI_AXIS)
+    return jax.jit(shard_map(step, mesh=mesh,
+                             in_specs=P((DCN_AXIS, ICI_AXIS)),
+                             out_specs=P((DCN_AXIS, ICI_AXIS))))
+"""
+
+MESH5_HYBRID_TABLE_CLEAN = """
+import jax
+
+def group_tables(comm, vdeg, dcn_axis, ici_axis):
+    comm_g = jax.lax.all_gather(comm, ici_axis, tiled=True)
+    vdeg_g = jax.lax.all_gather(vdeg, ici_axis, tiled=True)
+    return comm_g[: comm.shape[0]] + vdeg_g[: vdeg.shape[0]]
+"""
+
+
+def _mesh5_hybrid_project(table_src):
+    return {
+        "cuvite_tpu/fake_hmesh5.py": MESH5_HYBRID_MESH,
+        "cuvite_tpu/fake_hstep5.py": MESH5_HYBRID_STEP,
+        "cuvite_tpu/fake_htable5.py": table_src,
+    }
+
+
+def test_r023_hybrid_ici_gather_clean():
+    # The narrowed two-level table gather (ICI axis via the wrap's
+    # binding) is legal on the 2-D hybrid mesh: no finding.
+    findings = run_project_sources(
+        _mesh5_hybrid_project(MESH5_HYBRID_TABLE_CLEAN))
+    assert not any(f.rule in ("R023", "R024") for f in findings), findings
+
+
+def test_r023_hybrid_table_rewidened_to_flat_axis_convicted():
+    """ISSUE 18 sabotage, static half: re-widening one group table's
+    gather from the ICI submesh back to the retired flat global axis
+    ('v' — which no mesh in the hybrid project constructs) is exactly
+    an axis-name edit, and R023 convicts it cross-module."""
+    sab = MESH5_HYBRID_TABLE_CLEAN.replace(
+        'jax.lax.all_gather(comm, ici_axis, tiled=True)',
+        'jax.lax.all_gather(comm, "v", tiled=True)')
+    findings = run_project_sources(_mesh5_hybrid_project(sab))
+    hits = [f for f in findings if f.rule == "R023"]
+    assert len(hits) == 1, findings
+    assert hits[0].path == "cuvite_tpu/fake_htable5.py"
+    assert "'v'" in hits[0].message
+    assert "fake_hstep5.py::step" in hits[0].message
+
+
 def test_r024_conditional_collective_cross_module():
     findings = run_project_sources(
         _mesh5_project(MESH5_HELPER_DIVERGENT))

@@ -58,12 +58,28 @@ def test_mesh_audit_green_on_current_tree():
     assert reports["bucketed_replicated"]["8x1"].seq != sparse_seq
     assert reports["batched_fused"]["8x1"].seq == (), \
         "the batched program is collective-free by design"
+    # Two-level entry: tables gathered on the fast axis, ghosts routed
+    # on the slow one, and per-device table bytes shrinking ~1/|dcn|
+    # (the tentpole's whole point — 2x at |dcn|=2, 4x at |dcn|=4).
+    two_sigs = mc._flat_sigs(reports["bucketed_twolevel"]["4x2"].seq)
+    assert any(s == "all_gather(ici)" for s in two_sigs), two_sigs
+    assert any(s == "all_to_all(dcn)" for s in two_sigs), two_sigs
+    floors = {2: 1.8, 4: 3.5, 8: 7.0}
+    for tag, rep in reports["bucketed_twolevel"].items():
+        row = rep.categories["exchange_tables"]
+        ratio = row["global"] / row["per_device"]
+        assert ratio >= floors[rep.axes["dcn"]], (tag, row)
 
 
 def test_budget_manifest_closed_and_loadable():
     doc = mc.load_budget(BUDGET)
+    assert doc["version"] == mc.BUDGET_VERSION
     for cat in ("slab", "tables", "plans", "exchange", "scratch"):
         assert doc["categories"][cat]["law"] in ("sharded", "replicated")
+    # v2: the two-level categories carry the per-axis law — tables and
+    # grouped routing may reach full extent over |dcn|, never more.
+    for cat in ("exchange_tables", "exchange_grouped"):
+        assert doc["categories"][cat]["law"] == "ici_replicated"
 
 
 def test_missing_budget_fails_closed(tmp_path):

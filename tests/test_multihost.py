@@ -281,10 +281,14 @@ def test_four_process_dist_ingest_rmat15(tmp_path):
     assert abs(infos[0][0] - ref.modularity) < 1e-6
 
 
+@pytest.mark.slow
 def test_two_process_dist_ingest(tmp_path):
     """2-process per-host sharded ingest: each process range-reads only its
     4 shards' edges (remote shards carry no arrays), yet the clustering
-    matches the single-process full-ingest run."""
+    matches the single-process full-ingest run.
+
+    slow: ~50 s — the two-process protocol itself stays tier-1 via
+    test_two_process_run_matches_single."""
     from conftest import karate_edges
 
     from cuvite_tpu.core.graph import Graph

@@ -522,6 +522,21 @@ def test_perf_regress_stage_growth_and_floor():
     traj_e = [(p, n, dict(rec, engine="pallas")) for p, n, rec in traj]
     slow_engine = dict(fresh, engine="bucketed", value=10.0)
     assert check_regression(slow_engine, traj_e, 0.30) == []
+    # ISSUE 18: flat and two-level exchanges are separate arms — a
+    # two-level record never gates against the flat trajectory, and
+    # within the two-level arm the (dcn, ici) factorization must match
+    # (2x4 and 4x2 pay different ICI/DCN splits by design).
+    xb = {"mode": "twolevel", "dcn": 2, "ici": 4,
+          "table_bytes_per_device": 1024, "ghost_bytes": 512}
+    slow_two = dict(fresh, value=10.0, exchange=xb)
+    assert check_regression(slow_two, traj, 0.30) == []
+    traj_42 = [(p, n, dict(rec, exchange=dict(xb, dcn=4, ici=2)))
+               for p, n, rec in traj]
+    assert check_regression(slow_two, traj_42, 0.30) == []
+    traj_24 = [(p, n, dict(rec, exchange=dict(xb)))
+               for p, n, rec in traj]
+    assert any("TEPS" in p
+               for p in check_regression(slow_two, traj_24, 0.30))
 
 
 def test_perf_regress_self_check_catches_malformed(tmp_path):

@@ -41,9 +41,14 @@ def test_rmat15_overflows_row_chunk(rmat15):
     assert len([n for n in rows.values() if n > 0]) >= 4, rows
 
 
+@pytest.mark.slow
 def test_rmat15_bucketed_matches_sort_engine(rmat15):
     """Full-run equality of the two engines on a graph big enough to
-    exercise chunking and several buckets at once."""
+    exercise chunking and several buckets at once.
+
+    slow: ~19 s — rmat15 chunk-overflow and exchange-footprint coverage
+    stays tier-1 in this file; engine equality at smaller scales rides
+    test_bucketed.py."""
     rb = louvain_phases(rmat15, engine="bucketed")
     rs = louvain_phases(rmat15, engine="sort")
     assert rb.modularity == pytest.approx(rs.modularity, abs=5e-4)

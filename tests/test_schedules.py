@@ -242,9 +242,14 @@ def test_coloring_multishard_sparse_matches_single(karate):
     assert r8.modularity == pytest.approx(r1.modularity, abs=1e-6)
 
 
+@pytest.mark.slow
 def test_ordering_multishard_sparse_matches_single():
     """Vertex ordering on the sparse exchange: the frozen community-info
     tables ride the exchange's separate info grouping.
+
+    slow: ~23 s — ordering×multishard stays tier-1 on the replicated
+    exchange (test_ordering_multishard_matches_single) and
+    coloring×sparse via test_coloring_multishard_sparse_matches_single.
 
     Runs in a FRESH subprocess: this test owns the single largest compile
     in the suite (sharded per-class sparse steps), and an xdist worker

@@ -452,6 +452,21 @@ def test_validate_record_rejects_unchecked_nonzero_compiles():
                          ("compile_events", [{"dur_s": 1.0}]),
                          ("hbm_peak_by_buffer", [1, 2])):
         assert any(key in p for p in validate_record(dict(ok, **{key: bad_val}))), key
+    # ISSUE 18: the optional exchange block — a two-level record must
+    # carry its (dcn, ici) factorization and per-device table/ghost
+    # bytes; a flat SPMD record carries only the mode.
+    probs = validate_record(dict(ok, exchange={"mode": "twolevel"}))
+    for k in ("dcn", "ici", "table_bytes_per_device", "ghost_bytes"):
+        assert any(k in p for p in probs), (k, probs)
+    assert validate_record(dict(ok, exchange={
+        "mode": "twolevel", "dcn": 2, "ici": 4,
+        "table_bytes_per_device": 16384, "ghost_bytes": 6144})) == []
+    assert validate_record(dict(ok, exchange={"mode": "sparse"})) == []
+    assert any("mode" in p for p in validate_record(
+        dict(ok, exchange={"mode": "dense"})))
+    assert any("dcn" in p for p in validate_record(dict(ok, exchange={
+        "mode": "twolevel", "dcn": 0, "ici": 4,
+        "table_bytes_per_device": 16384, "ghost_bytes": 6144})))
 
 
 # ---------------------------------------------------------------------------

@@ -1,9 +1,11 @@
 """Mesh audit CLI (graftlint tier 5, dynamic half).
 
 Runs the real sharded entries — the per-graph bucketed SPMD step under
-the replicated, sparse, and auto-cutover exchanges, and the batched
-fused/bucketed phase programs — across the virtual mesh shapes
-{8x1, 4x2, 2x4} of tier-1's forced-CPU 8-device pool, and grades:
+the replicated, sparse, auto-cutover, and two-level (hybrid dcn/ici
+mesh) exchanges, and the batched fused/bucketed phase programs —
+across the virtual mesh shapes {8x1, 4x2, 2x4} of tier-1's forced-CPU
+8-device pool (the two-level entry reads each shape as its (dcn, ici)
+factorization), and grades:
 
   * M001 — per-shard collective sequences: extracted from the traced
     jaxprs; a cond whose branches issue different collective
@@ -140,9 +142,12 @@ def main(argv=None) -> int:
         else:
             for ent in inv:
                 print(f"{ent['rel']}:{ent['line']}: {ent['call']} "
-                      f"[{ent['size']}] — {ent['reason']}")
+                      f"[{ent['size']}] [scope={ent['scope']}] — "
+                      f"{ent['reason']}")
+            n_global = sum(1 for ent in inv if ent["scope"] == "global")
             print(f"mesh_audit: {len(inv)} justified replicated "
-                  "buffer(s) in the inventory")
+                  f"buffer(s) in the inventory; {n_global} with global "
+                  "scope (two-level contract: 0)")
         return 0
 
     # nargs="*" admits a bare `--entries` (e.g. an empty $ENTRIES in a

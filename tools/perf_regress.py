@@ -167,6 +167,23 @@ def comparable(fresh: dict, rec: dict) -> bool:
         for k in ("warm", "churn_frac"):
             if ft.get(k) != rt.get(k):
                 return False
+    # Exchange arms (ISSUE 18): a two-level record never gates a flat
+    # one (or vice versa) — shrinking the per-chip table window by
+    # |dcn| changes the exchange cost model, not just a constant — and
+    # within the two-level arm the (dcn, ici) factorization must match
+    # (2x4 and 4x2 pay different ICI/DCN splits by design).  A record
+    # with no `exchange` block predates ISSUE 18 or ran single-shard;
+    # it compares only against other block-less records.
+    fx, rx = fresh.get("exchange"), rec.get("exchange")
+    if (fx is None) != (rx is None):
+        return False
+    if fx is not None:
+        if fx.get("mode") != rx.get("mode"):
+            return False
+        if fx.get("mode") == "twolevel":
+            for k in ("dcn", "ici"):
+                if fx.get(k) != rx.get(k):
+                    return False
     return True
 
 

@@ -26,7 +26,10 @@ valuable result first):
   and stage I (tools/mesh_audit.py across the slice's pow2 mesh
   shapes — the first on-chip M00x evidence: collective sequences,
   cross-shape label bit-identity, per-chip HBM scaling laws;
-  ISSUE 15).
+  ISSUE 15), stage J (width audit on the TPU lowering, ISSUE 16),
+  stage K (streaming churn A/B, ISSUE 17), and stage L (flat 8x1 vs
+  two-level 2x4/4x2 exchange A/B + the per-axis ICI-vs-DCN collective
+  microbench, ISSUE 18).
 
 Success marker: tools/TPU_LADDER3_DONE (platform!=cpu bench JSON
 landed).  Every result appends to tools/logs/tpu_ladder_r4.log immediately.
@@ -426,6 +429,67 @@ def stage_k():
                 "recompiled; no JSON by design")
 
 
+def stage_l(platform, ndev):
+    """Two-level exchange A/B on chip (ISSUE 18): the SAME rmat-20
+    clustering over the flat 1-D mesh (8x1: sparse exchange, tables at
+    the full nv_total window) vs the hybrid factorizations (2x4, 4x2:
+    community tables replicated only inside the ICI submesh, sparse
+    ghost routing on the DCN axis).  Labels are bit-identical by the
+    M002 gate — the number this stage adds is the WALL/exchange cost of
+    shrinking the per-chip table window by |dcn|, on real ICI vs DCN
+    links instead of tier-1's uniform virtual host axes.  Each shape
+    writes its own JSON line the moment it exists."""
+    if ndev < 8:
+        log(f"L: skipped (ndev={ndev} < 8; the A/B needs the 8-chip "
+            "factorizations)")
+        return
+    for shape in ("8x1", "2x4", "4x2"):
+        out_path = os.path.join(REPO, f"tools/cli_tpu_twolevel_{shape}.json")
+        cmd = [sys.executable, "-m", "cuvite_tpu.cli",
+               "--rmat", "20", "--engine", "bucketed",
+               "--platform", platform, "--json", "--quiet"]
+        d, _, i = shape.partition("x")
+        if d == "1" or i == "1":
+            cmd += ["--shards", "8", "--exchange", "sparse"]
+        else:
+            cmd += ["--mesh", shape]
+        t0 = time.perf_counter()
+        try:
+            out = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=2400, cwd=REPO)
+        except subprocess.TimeoutExpired:
+            log(f"L: twolevel shape={shape} TIMEOUT (2400s)")
+            continue
+        line = ""
+        for ln in reversed(out.stdout.strip().splitlines() or [""]):
+            if ln.startswith("{"):
+                line = ln
+                break
+        log(f"L: twolevel shape={shape} rc={out.returncode} "
+            f"wall={time.perf_counter()-t0:.0f}s "
+            f"json={line or out.stderr[-200:]}")
+        if out.returncode == 0 and line:
+            with open(out_path, "w") as f:
+                f.write(line + "\n")
+    # The per-axis collective microbench: intra-ICI all_gather vs
+    # cross-DCN all_to_all launch + payload cost at the table scales the
+    # A/B above exercises (tools/exchange_latency.py --mesh mode).
+    out_path = os.path.join(REPO, "tools", "exchange_latency_tpu_2axis.json")
+    try:
+        out = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "tools", "exchange_latency.py"),
+             "--mesh", "2x4", "--out", out_path],
+            capture_output=True, text=True, timeout=1200, cwd=REPO,
+            env=dict(os.environ, CUVITE_PLATFORM=platform))
+        tail = out.stdout.strip().splitlines()
+        log(f"L: exchange_latency --mesh 2x4 rc={out.returncode} "
+            f"tail={tail[-1] if tail else out.stderr[-200:]} "
+            f"(json: {out_path})")
+    except subprocess.TimeoutExpired:
+        log("L: exchange_latency --mesh TIMEOUT (1200s)")
+
+
 def main():
     parts = probe()
     if parts is None:
@@ -516,6 +580,12 @@ def main():
         stage_k()
     except Exception as e:
         log(f"K: FAILED {type(e).__name__}: {e}")
+    # Stage L (ISSUE 18): flat vs two-level exchange A/B across the
+    # 8-chip mesh factorizations + the two-axis collective microbench.
+    try:
+        stage_l(parts[0], int(parts[1]))
+    except Exception as e:
+        log(f"L: FAILED {type(e).__name__}: {e}")
     if got_tpu_json:
         with open(DONE, "w") as f:
             f.write(time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()) + "\n")
