@@ -936,11 +936,15 @@ class UnsyncedTimingWindow(Rule):
 # CPU: above ~2^15 padded vertices the packed int32 key no longer fits
 # and lax.sort degrades to its slowest variadic comparator).  The ONLY
 # sanctioned full-slab sort for the coalesce is the fallback chokepoint
-# ops/segment.py::coalesced_runs (via sort_edges_by_vertex_comm), which
-# reports its engagement as bench coverage (`coalesce_kernel`).  A new
-# direct sort in coarsen/ or kernels/ would bypass both the dense
-# seg_coalesce engines and the coverage accounting — silently
-# re-imposing the tax.
+# ops/segment.py::coalesced_runs (via sort_edges_by_vertex_comm or
+# sort_edges_msd), which reports its engagement as bench coverage
+# (`coalesce_kernel`).  A new direct sort in coarsen/ or kernels/ would
+# bypass both the dense seg_coalesce engines and the coverage
+# accounting — silently re-imposing the tax.  The scope deliberately
+# covers the ISSUE-19 modules: the device re-binner (coarsen/rebin.py)
+# and the sort-free hash coalesce (kernels/seg_coalesce.py::hash_emit)
+# exist precisely to AVOID per-phase sorts, so a lax.sort creeping into
+# either is the regression this rule is for.
 
 _SLAB_SORT_SCOPE = (
     "cuvite_tpu/coarsen/",
@@ -1055,6 +1059,11 @@ class ServeLoopCompileTrap(Rule):
 # matrices per tenant per dispatch, turning the pack-time amortization
 # into per-job host work — results unchanged, throughput silently
 # gone, exactly the regression class R014 guards on the compile side.
+# Since ISSUE 19 coarse phases re-bin their plans ON DEVICE inside the
+# compiled phase program (coarsen/rebin.py::rebin_plan /
+# device_rebin_plan — the sanctioned in-loop planner, deliberately NOT
+# in the trap set): a serve loop that calls the host builders per
+# phase is silently falling back from that path.
 
 _PLAN_BUILD_CALLS = {
     "BucketPlan.build", "bucketed.BucketPlan.build",
@@ -1077,8 +1086,10 @@ class ServeLoopPlanTrap(Rule):
                 f"{fname}() inside a serve/ dispatch loop builds "
                 "bucket plans per job: planning belongs at PACK "
                 "time — one batch_bucket_plans call per packed "
-                "batch inside run_batched (louvain/batched.py), "
-                "covering every row in one host pass; hoist the "
+                "batch inside run_batched (louvain/batched.py) — "
+                "and coarse-phase re-planning belongs ON DEVICE "
+                "(coarsen/rebin.py::device_rebin_plan, the "
+                "sanctioned in-loop re-binner); hoist the host "
                 "plan construction out of the loop, or justify "
                 "with an inline '# graftlint: disable=R015'")
 

@@ -178,9 +178,14 @@ def batched_coarsen_slab(src, dst, w, comm, real_mask, dense_map, nc, *,
     per-row ``dense_map``/``nc`` required — the batched driver always
     has them from the label composition).  ``coalesce`` must be an
     EXPLICIT engine and not ``'pallas'``: the Pallas grid does not lift
-    over a batch axis; the XLA twin and the packed sort both do."""
-    assert coalesce in ("sort", "xla"), \
-        f"batched coalesce engine {coalesce!r}: vmap lifts 'sort'/'xla' only"
+    over a batch axis; the XLA twin, the packed sort, and the msd
+    two-pass sort all do.  (Not ``'hash'`` either: its per-row
+    ``lax.cond`` retry would execute BOTH branches under vmap — the
+    batched policy routes hash to 'msd' instead,
+    louvain/batched.py::_batched_coalesce_engine.)"""
+    assert coalesce in ("sort", "xla", "msd"), \
+        f"batched coalesce engine {coalesce!r}: vmap lifts " \
+        "'sort'/'xla'/'msd' only"
 
     def one(s, d, ww, c, rm, dm, n):
         return device_coarsen_slab(

@@ -111,10 +111,15 @@ def coalesce_engine(nv_pad: int, accum_dtype=None) -> str:
 
     CUVITE_SEG_COALESCE: '' (default) — the packed-sort path; 'xla' /
     'dense' / '1' — the XLA dense twin where the class fits; 'pallas' —
-    the tile kernel (interpret off-TPU); '0' / 'sort' — explicit sort
-    pin.  Ineligible classes (domain over budget, ds32) degrade to
-    'sort' in every mode, with coverage reported by the drivers
-    (the PALLAS_MAX_WIDTH degrade-with-coverage pattern).
+    the tile kernel (interpret off-TPU); 'msd' — the two-pass int32 MSD
+    sort (ops/segment.sort_edges_msd: never degrades — ds32-capable,
+    no domain cap, and identical to 'sort' below the 31-bit pack
+    ceiling); 'hash' — the hash-slot coalesce below (explicit
+    accumulators route to 'msd': its tables sum in the weight dtype);
+    '0' / 'sort' — explicit sort pin.  Ineligible classes (domain over
+    budget, ds32) degrade the DENSE modes to 'sort', with coverage
+    reported by the drivers (the PALLAS_MAX_WIDTH
+    degrade-with-coverage pattern).
 
     Why default-off (measured, this rig, 24-core CPU backend): every
     ELIGIBLE class (nv_pad <= 4096 -> 25-bit key) already rides the
@@ -132,7 +137,8 @@ def coalesce_engine(nv_pad: int, accum_dtype=None) -> str:
     mode = os.environ.get("CUVITE_SEG_COALESCE", "").strip().lower()
     if mode in ("", "0", "false", "sort"):
         return "sort"
-    if mode not in ("1", "true", "dense", "xla", "pallas"):
+    if mode not in ("1", "true", "dense", "xla", "pallas", "msd",
+                    "hash"):
         # A typo'd pin must never silently measure the wrong engine
         # (the CUVITE_EXCHANGE_CUTOVER precedent): warn, keep the
         # default.
@@ -140,9 +146,19 @@ def coalesce_engine(nv_pad: int, accum_dtype=None) -> str:
 
         warnings.warn(
             f"unrecognized CUVITE_SEG_COALESCE={mode!r} (want sort/0, "
-            "xla/dense/1, or pallas); using the default 'sort'",
-            stacklevel=2)
+            "xla/dense/1, pallas, msd, or hash); using the default "
+            "'sort'", stacklevel=2)
         return "sort"
+    if mode == "msd":
+        # The msd sort shares the sorted-runs tail with 'sort': every
+        # accumulator (ds32 included) and every class is legal.
+        return "msd"
+    if mode == "hash":
+        # Hash tables sum in the weight dtype in slab order: explicit
+        # accumulators take the msd SORTING path instead (same order as
+        # 'sort', so ds32 pair sums stay exact) rather than plain
+        # 'sort' — the operator asked for a big-class engine.
+        return "hash" if accum_dtype is None else "msd"
     if accum_dtype is not None:
         # Any explicit accumulator degrades to sort: ds32 needs the
         # sorted segmented pair arithmetic (ops/exactsum), and a wider
@@ -295,3 +311,117 @@ def coalesce_slab(src, dst, w, *, nv_pad: int, engine: str,
         acc, cnt = seg_coalesce_xla(src, dst, w, nv_pad=nv_pad)
     return emit_coalesced(acc, cnt, ne_pad=src.shape[0],
                           src_dtype=src.dtype, dst_dtype=dst.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Hash-slot coalesce (the big-class engine of ISSUE 19): K static slots
+# per src — a [nv_pad * K] table instead of the dense [nv_pad^2] domain,
+# so classes FLAT_NV_MAX rules out (nv_pad >= 2^16) stay in one O(ne)
+# scatter pass.  A slot receiving two distinct dst keys cannot emit;
+# collision detection is DEVICE-side (scatter-min/max of dst per slot)
+# and the caller (ops/segment.coalesced_runs) retries the slab through
+# the msd-sorted tail inside lax.cond — no host sync, bit-identical to
+# the sort engines either way.
+
+# Table ceiling: the flat src * K + slot index is int32 and the
+# emission cumsum counts table slots, so nv_pad * K stays <= 2^30 (the
+# SLAB_NE_MAX discipline); the rank matrix below adds a [nv_pad, K, K]
+# transient, so K is further bounded to keep it ~2^28 elements.
+HASH_TABLE_MAX = 1 << 30
+HASH_RANK_MAX = 1 << 28
+_HASH_MULT = 2654435761  # Knuth's 2^32 / phi multiplicative constant
+
+
+def hash_slots(nv_pad: int, ne_pad: int) -> int:
+    """STATIC slot count per src for one slab class: pow2, derived from
+    the class's mean degree (~4x headroom so light tails rarely
+    collide), floored at 16, capped by nv_pad and the table/rank element
+    budgets.  CUVITE_HASH_SLOTS overrides (still clamped pow2) — the
+    A/B sweep knob."""
+    from cuvite_tpu.utils.envknob import env_int
+
+    k = env_int("CUVITE_HASH_SLOTS", 0, minimum=0, maximum=1 << 12)
+    if k <= 0:
+        avg = max(ne_pad // max(nv_pad, 1), 1)
+        k = min(nv_pad, max(16, 4 * avg))
+    k = 1 << max(int(k - 1).bit_length(), 0)  # pow2 ceiling
+    while k > 1 and (nv_pad * k > HASH_TABLE_MAX
+                     or nv_pad * k * k > HASH_RANK_MAX):
+        k >>= 1
+    return k
+
+
+def hash_accumulate(src, dst, w, *, nv_pad: int, k: int):
+    """One O(ne) scatter pass over the [nv_pad * K] slot table.  src/dst:
+    [ne_pad] ids < nv_pad (padding src == nv_pad, w == 0); returns
+    ``(wsum, cnt, dmin, dmax)`` flat [nv_pad * K] tables — weight sum,
+    run presence count, and the min/max dst seen per slot (equal iff the
+    slot is collision-free)."""
+    assert k & (k - 1) == 0, k
+    real = src < nv_pad
+    if k == 1:
+        slot = jnp.zeros(src.shape, jnp.int32)
+    else:
+        log2k = (k - 1).bit_length()
+        slot = (dst.astype(jnp.uint32) * jnp.uint32(_HASH_MULT)
+                >> (32 - log2k)).astype(jnp.int32)
+    flat = jnp.where(real, src.astype(jnp.int32) * k + slot,
+                     jnp.int32(nv_pad * k))  # graftlint: width-ok=hash_slots caps nv_pad * k at HASH_TABLE_MAX = 2^30, int32-safe
+    d32 = dst.astype(jnp.int32)
+    big = jnp.int32(nv_pad)  # > every real dst
+    zero_w = jnp.zeros_like(w)
+    wsum = jnp.zeros((nv_pad * k,), w.dtype).at[flat].add(
+        jnp.where(real, w, zero_w), mode="drop")
+    cnt = jnp.zeros((nv_pad * k,), jnp.int32).at[flat].add(
+        real.astype(jnp.int32), mode="drop")
+    dmin = jnp.full((nv_pad * k,), big).at[flat].min(
+        jnp.where(real, d32, big), mode="drop")
+    dmax = jnp.zeros((nv_pad * k,), jnp.int32).at[flat].max(
+        jnp.where(real, d32, jnp.int32(0)), mode="drop")
+    return wsum, cnt, dmin, dmax
+
+
+def hash_emit(wsum, cnt, dmin, *, nv_pad: int, ne_pad: int, k: int,
+              src_dtype, ckey_dtype):
+    """Compact a collision-free slot table into the coalesced slab
+    prefix, rows in ascending (src, dst) order — bit-identical (offsets,
+    tails, and weights on the exactness domain) to the sorted paths.
+
+    Within one src the occupied slots hold provably DISTINCT dst (equal
+    dst hash to one slot), so the dst-ascending order inside each row is
+    recovered SORT-FREE by an O(K^2) rank — this module sits inside
+    graftlint R013's no-sort scope, and K is a small static constant,
+    not a slab dimension.  Empty slots carry the sentinel nv_pad and
+    rank after every real dst; sentinel ties break by slot index so the
+    ranks form a permutation and the reordering scatter is exact."""
+    dst_t = jnp.where(cnt > 0, dmin, jnp.int32(nv_pad)) \
+        .reshape(nv_pad, k)
+    w_t = jnp.where(cnt.reshape(nv_pad, k) > 0, wsum.reshape(nv_pad, k),
+                    jnp.zeros_like(wsum.reshape(nv_pad, k)))
+    sl = jnp.arange(k, dtype=jnp.int32)
+    before = (dst_t[:, :, None] > dst_t[:, None, :]) | (
+        (dst_t[:, :, None] == dst_t[:, None, :])
+        & (sl[None, :, None] > sl[None, None, :]))
+    rank = jnp.sum(before, axis=2, dtype=jnp.int32)  # [nv_pad, k]
+    row = jnp.arange(nv_pad, dtype=jnp.int32)[:, None]
+    ordered_d = jnp.full((nv_pad, k), nv_pad, jnp.int32) \
+        .at[row, rank].set(dst_t)
+    ordered_w = jnp.zeros((nv_pad, k), w_t.dtype).at[row, rank].set(w_t)
+    flat_d = ordered_d.reshape(-1)
+    flat_w = ordered_w.reshape(-1)
+    present = flat_d < nv_pad
+    # Ascending (row, rank) order IS ascending (src, dst): the standard
+    # cumsum compaction (emit_coalesced) lands the prefix directly.
+    # Distinct pairs <= real edges <= ne_pad, so pos never overflows the
+    # output class even when nv_pad * k > ne_pad.
+    n = jnp.sum(present.astype(jnp.int32))
+    pos = jnp.cumsum(present.astype(jnp.int32)) - 1
+    slot = jnp.where(present, pos, ne_pad)  # absent keys drop
+    srcs = jnp.repeat(jnp.arange(nv_pad, dtype=jnp.int32), k)
+    src_c = jnp.full((ne_pad,), nv_pad, src_dtype).at[slot].set(
+        srcs.astype(src_dtype), mode="drop")
+    ckey_c = jnp.zeros((ne_pad,), ckey_dtype).at[slot].set(
+        flat_d.astype(ckey_dtype), mode="drop")
+    w_c = jnp.zeros((ne_pad,), flat_w.dtype).at[slot].set(flat_w,
+                                                          mode="drop")
+    return src_c, ckey_c, w_c, n

@@ -81,9 +81,14 @@ from cuvite_tpu.utils.upload import to_device
 # 2-channel lax.sort sweep) every phase; 'bucketed' — phase 0 runs the
 # vmapped BUCKETED sweep over cross-graph-padded plans (ISSUE 10; the
 # sort-free formulation every per-graph benchmark shows is the fast
-# one), phases >= 1 keep the fused loop (coarse graphs are small, and
-# re-binning their plans would need a device-side histogram).  The
-# per-phase engine actually used is recorded in BatchResult.phase_engines.
+# one), and phases >= 1 RE-BIN ON DEVICE (ISSUE 19): the coarse slab is
+# re-bucketed inside the phase program by coarsen/rebin.py's histogram +
+# gather builder, so coarse phases stay on the sort-free formulation
+# too.  Classes the re-binner cannot certify (possible heavy residual,
+# element budget — coarsen/rebin.py::rebin_eligible) and
+# CUVITE_DEVICE_REBIN=0 fall back to the fused loop, the pre-ISSUE-19
+# downgrade.  The per-phase engine actually used is recorded in
+# BatchResult.phase_engines.
 
 
 def _phase_body(src, dst, w, comm_all, real_mask, prev_mod, active,
@@ -169,6 +174,61 @@ def _bucketed_phase_body(buckets, heavy, self_loop, perm, src, dst, w,
         nv_pad=nv_pad, accum_dtype=accum_dtype, coalesce=coalesce)
 
 
+def _rebinned_phase_body(src, dst, w, comm_all, real_mask, prev_mod,
+                         active, constant, threshold, *, nv_pad,
+                         accum_dtype, coalesce,
+                         max_iters=MAX_TOTAL_ITERATIONS):
+    """The sort-free COARSE phase (ISSUE 19): same 9-operand contract as
+    :func:`_phase_body`, but the row sweep is the bucketed formulation
+    over a plan built ON DEVICE from the coarse slab by
+    :func:`cuvite_tpu.coarsen.rebin.rebin_plan` — degree histogram,
+    static-ladder class assignment, gather into the stacked
+    ``[rows, width]`` layout — vmapped over the batch.  The coarse slab
+    rows satisfy the re-binner's contract by construction: the vmapped
+    coalesce emits ascending compacted runs with a padding tail, the
+    masked-exit rows are pure padding, and ``_shrink_batch`` preserves
+    the prefix.  Plan geometry is derived from the static slab class
+    (``src.shape[-1]``), so the program is one compile per (class, B)
+    like the fused body it replaces; eligibility (no heavy residual
+    possible, element budget) is the CALLER's gate —
+    ``rebin_eligible`` must hold for this body's class.
+
+    The coarsen + masked-exit tail is shared with the other bodies, so
+    phase transitions cannot drift between engines.
+    """
+    from cuvite_tpu.coarsen.rebin import rebin_geometry, rebin_plan
+    from cuvite_tpu.louvain.driver import _bucketed_call, _run_phase_loop
+
+    wdt = w.dtype
+    ne_pad = src.shape[-1]
+    geom = rebin_geometry(nv_pad, ne_pad)
+    sentinel = int(np.iinfo(np.int32).max)
+    call = _bucketed_call(nv_pad, sentinel, accum_dtype)
+    lower = jnp.asarray(-1.0, dtype=wdt)
+    th = jnp.asarray(threshold, dtype=wdt)
+
+    def one(s, d, ww, c):
+        bk, hv, sl, pm = rebin_plan(s, d, ww, nv_pad=nv_pad, base=0,
+                                    geometry=geom)
+        vdeg = seg.segment_sum(ww, s, num_segments=nv_pad,
+                               sorted_ids=True)
+        comm0 = jnp.arange(nv_pad, dtype=jnp.int32)
+        # The trailing None is the heavy-kernel slot of the single-shard
+        # bucketed call convention (sorted heavy path — here the static
+        # 8-slot padding placeholder the re-binner certifies).
+        extra = (bk, hv, sl, vdeg, c, pm, None)
+        return _run_phase_loop(extra, comm0, th, lower, call=call,
+                               max_iters=max_iters)
+
+    past, mod, iters, _ovf, (cq, cmoved, covf) = jax.vmap(one)(
+        src, dst, w, constant)
+
+    return _phase_tail(
+        src, dst, w, comm_all, real_mask, prev_mod, active, threshold,
+        past, mod, iters, cq, cmoved, covf,
+        nv_pad=nv_pad, accum_dtype=accum_dtype, coalesce=coalesce)
+
+
 def _phase_tail(src, dst, w, comm_all, real_mask, prev_mod, active,
                 threshold, past, mod, iters, cq, cmoved, covf, *,
                 nv_pad, accum_dtype, coalesce):
@@ -242,13 +302,16 @@ def _batched_coalesce_engine(nv_pad: int, adt: str) -> str:
     """The coalesce engine of a batched phase at one slab class: the
     env-resolved per-graph policy, with 'pallas' downgraded to its
     bit-identical XLA twin — the Pallas seg-coalesce grid does not lift
-    over vmap (kernels/seg_coalesce.py).  One definition for the
-    phase-0 class and the serving-coarse class, so the downgrade rule
-    cannot drift between them."""
+    over vmap (kernels/seg_coalesce.py) — and 'hash' downgraded to
+    'msd': the hash engine's collision retry is a ``lax.cond`` whose
+    branches BOTH execute under vmap, so its fallback path would run
+    for every row of every batch (coarsen/device.py).  One definition
+    for the phase-0 class and the serving-coarse class, so the
+    downgrade rule cannot drift between them."""
     from cuvite_tpu.kernels.seg_coalesce import coalesce_engine
 
     eng = coalesce_engine(nv_pad, "ds32" if adt == "ds32" else None)
-    return "xla" if eng == "pallas" else eng
+    return {"pallas": "xla", "hash": "msd"}.get(eng, eng)
 
 
 @functools.partial(jax.jit, static_argnames=("cnv", "cne"))
@@ -274,8 +337,10 @@ def _get_batched_phase(mesh, nv_pad, accum_dtype, coalesce, max_iters,
     """The compiled batched-phase program for one ``(mesh, class
     statics, engine)`` — ``engine='bucketed'`` adds the plan pytree
     (``n_buckets`` triples + heavy/self_loop/perm) ahead of the slab
-    state; jax.jit still caches per shapes, so a bucketed program is one
-    compile per (class, B, bucket geometry)."""
+    state; ``engine='rebinned'`` keeps the fused 9-operand signature
+    (its plan is built inside the program).  jax.jit still caches per
+    shapes, so a bucketed program is one compile per (class, B, bucket
+    geometry)."""
     key = (
         None if mesh is None else tuple(d.id for d in mesh.devices.flat),
         nv_pad, accum_dtype, coalesce, max_iters, engine, n_buckets,
@@ -285,7 +350,9 @@ def _get_batched_phase(mesh, nv_pad, accum_dtype, coalesce, max_iters,
         return fn
     bucketed = engine == "bucketed"
     body = functools.partial(
-        _bucketed_phase_body if bucketed else _phase_body,
+        {"bucketed": _bucketed_phase_body,
+         "rebinned": _rebinned_phase_body,
+         "fused": _phase_body}[engine],
         nv_pad=nv_pad, accum_dtype=accum_dtype,
         coalesce=coalesce, max_iters=max_iters)
     if mesh is None:
@@ -347,9 +414,12 @@ class BatchResult:
     b_pad: int
     n_jobs: int
     slab_class: tuple      # (nv_pad, ne_pad)
-    # Engine telemetry (ISSUE 10): the engine each batch phase actually
-    # ran — ['bucketed', 'fused', ...] under engine='bucketed' (phase 0
-    # sort-free, coarse phases fused), all-'fused' otherwise.
+    # Engine telemetry (ISSUE 10/19): the engine each batch phase
+    # actually ran — ['bucketed', 'rebinned', ...] under
+    # engine='bucketed' (phase 0 sort-free over pack-time plans, coarse
+    # phases over device-rebuilt plans; 'fused' where the re-binner
+    # cannot certify the class or CUVITE_DEVICE_REBIN=0), all-'fused'
+    # otherwise.
     phase_engines: list = dataclasses.field(default_factory=list)
     # The serving-coarse class phases >= 1 ran at (engine='bucketed'
     # whose post-phase-0 batch fit `_coarse_class`), else None.
@@ -564,8 +634,27 @@ def execute_prepared(prep: PreparedBatch, *, threshold: float = 1.0e-6,
     adt = prep.adt
     eng = prep.coalesce
     mesh = prep.mesh
-    phase_fn = _get_batched_phase(mesh, nv_pad, adt, eng,
-                                  MAX_TOTAL_ITERATIONS)
+    def _coarse_fn(nv, ne, engc):
+        # Coarse-phase program of the current slab class: under
+        # engine='bucketed', device re-binning (ISSUE 19) keeps coarse
+        # phases on the sort-free bucketed formulation whenever the
+        # re-binner can certify the class (no heavy residual possible,
+        # element budget) and CUVITE_DEVICE_REBIN is on; otherwise the
+        # pre-ISSUE-19 fused downgrade.
+        from cuvite_tpu.coarsen.rebin import (
+            device_rebin_enabled,
+            rebin_eligible,
+        )
+
+        if (prep.engine == "bucketed" and device_rebin_enabled()
+                and rebin_eligible(nv, ne)):
+            return _get_batched_phase(
+                mesh, nv, adt, engc, MAX_TOTAL_ITERATIONS,
+                engine="rebinned"), "rebinned"
+        return _get_batched_phase(mesh, nv, adt, engc,
+                                  MAX_TOTAL_ITERATIONS), "fused"
+
+    phase_fn, coarse_engine = _coarse_fn(nv_pad, prep.ne_pad, eng)
     phase0_fn = None
     if prep.engine == "bucketed":
         phase0_fn = _get_batched_phase(
@@ -590,11 +679,14 @@ def execute_prepared(prep: PreparedBatch, *, threshold: float = 1.0e-6,
         t1 = time.perf_counter()
         active_at_start = active.copy()
         # Phase 0 under engine='bucketed' runs the sort-free vmapped
-        # bucketed sweep over the pack-time plans; coarse phases (and
-        # every phase of engine='fused') run the fused loop.  The engine
-        # per phase is recorded for telemetry/bench provenance.
+        # bucketed sweep over the pack-time plans; coarse phases re-bin
+        # their plans on device when eligible ('rebinned', ISSUE 19),
+        # else run the fused loop (also every phase of engine='fused').
+        # The engine per phase is recorded for telemetry/bench
+        # provenance.
         bucketed_phase = phase == 0 and phase0_fn is not None
-        phase_engines.append("bucketed" if bucketed_phase else "fused")
+        phase_engines.append("bucketed" if bucketed_phase
+                             else coarse_engine)
         # HBM ledger: re-track the live set per phase, so the phase-0
         # plan buffers leave the accounting once dropped and the slab
         # bytes follow the serving-coarse shrink (the snapshot below
@@ -659,7 +751,8 @@ def execute_prepared(prep: PreparedBatch, *, threshold: float = 1.0e-6,
         tracer.ledger_snapshot(phase)
         if bucketed_phase:
             # The phase-0 plans are dead weight from here on (coarse
-            # phases run fused); drop the device refs so HBM frees.
+            # phases re-bin on device or run fused); drop the device
+            # refs so HBM frees.
             plan_d = None
             # One-notch coarse-class shrink (see _coarse_class): iff
             # every row still clustering fits, the batch drops to the
@@ -674,9 +767,8 @@ def execute_prepared(prep: PreparedBatch, *, threshold: float = 1.0e-6,
                     src_d, dst_d, w_d, rm_d, cnv=cnv, cne=cne)
                 cur_nv, cur_ne = cnv, cne
                 coarse_class = (cnv, cne)
-                phase_fn = _get_batched_phase(
-                    mesh, cnv, adt, _batched_coalesce_engine(cnv, adt),
-                    MAX_TOTAL_ITERATIONS)
+                phase_fn, coarse_engine = _coarse_fn(
+                    cnv, cne, _batched_coalesce_engine(cnv, adt))
         phase += 1
 
     # THE final label gather: one O(B * nv_pad) transfer for the whole

@@ -122,16 +122,20 @@ class Tracer:
 
     # Stage names the drivers use, in pipeline order.  These are the
     # bench record's REQUIRED per-stage fields (ISSUE 3 satellite;
-    # coalesce since ISSUE 8): coarsen_s — inter-phase graph rebuild
-    # (host or device); coalesce_s — the device relabel+coalesce slice,
-    # NESTED inside coarsen_s (coarsen_s CONTAINS coalesce_s; 0.0 on the
-    # host-compaction path), split out so the round-7 sort tax is a
-    # measured field; upload_s — host->device placement of slabs/plans;
-    # iterate_s — the jitted phase loops.  Note upload runs NESTED
-    # inside the driver's plan stage on the per-phase engine path, so
-    # there plan_s CONTAINS upload_s (the fused driver's stages are
-    # disjoint).
-    CANONICAL_STAGES = ("coarsen", "coalesce", "upload", "iterate")
+    # coalesce since ISSUE 8, rebin since ISSUE 19): coarsen_s —
+    # inter-phase graph rebuild (host or device); coalesce_s — the
+    # device relabel+coalesce slice, NESTED inside coarsen_s (coarsen_s
+    # CONTAINS coalesce_s; 0.0 on the host-compaction path), split out
+    # so the round-7 sort tax is a measured field; rebin_s — the device
+    # plan re-bin of a coarse phase (coarsen/rebin.py; runs NESTED
+    # inside the driver's plan stage, so plan_s CONTAINS rebin_s; 0.0
+    # on the host BucketPlan.build path and on non-bucketed engines);
+    # upload_s — host->device placement of slabs/plans; iterate_s — the
+    # jitted phase loops.  Note upload runs NESTED inside the driver's
+    # plan stage on the per-phase engine path, so there plan_s CONTAINS
+    # upload_s (the fused driver's stages are disjoint).
+    CANONICAL_STAGES = ("coarsen", "coalesce", "rebin", "upload",
+                        "iterate")
 
     def breakdown(self) -> dict:
         """Per-stage seconds for machine consumers (the bench JSON's

@@ -57,11 +57,15 @@ REQUIRED_PALLAS_KEYS = ("pallas_coverage", "pallas_width_hits")
 
 # Per-stage wall-clock fields every record must carry (schema v2, ISSUE 3;
 # coalesce_s since ISSUE 8 — the device relabel+coalesce slice nested
-# inside coarsen_s, i.e. the round-7 sort tax as its own gated number):
-# the breakdown that makes the device-resident coarsening win measurable
-# per phase instead of hiding inside one wall number.  Taken from the
-# tracer of the RECORDED run (utils.trace.Tracer.breakdown).
-REQUIRED_STAGE_KEYS = ("coarsen_s", "coalesce_s", "upload_s", "iterate_s")
+# inside coarsen_s, i.e. the round-7 sort tax as its own gated number;
+# rebin_s since ISSUE 19 — the device plan re-bin of coarse bucketed
+# phases, nested inside the driver's plan_s, 0.0 on the host
+# BucketPlan.build path): the breakdown that makes the device-resident
+# coarsening win measurable per phase instead of hiding inside one wall
+# number.  Taken from the tracer of the RECORDED run
+# (utils.trace.Tracer.breakdown).
+REQUIRED_STAGE_KEYS = ("coarsen_s", "coalesce_s", "rebin_s", "upload_s",
+                       "iterate_s")
 
 
 class BenchCompileGuardError(RuntimeError):
@@ -149,6 +153,17 @@ def validate_record(rec: dict) -> list:
             problems.append(
                 f"coalesce_kernel must be a fraction in [0, 1], got "
                 f"{ck!r}")
+        rd = rec.get("rebin_device")
+        if rd is not None and not (isinstance(rd, (int, float))
+                                   and 0.0 <= rd <= 1.0):
+            # Optional (bucketed-engine runs only, ISSUE 19): the
+            # fraction of coarse phases whose bucket plan was built ON
+            # DEVICE (coarsen/rebin.py) instead of by the host
+            # BucketPlan.build — the arm label perf_regress needs to
+            # keep device-rebin and host-rebin plan_s non-comparable.
+            problems.append(
+                f"rebin_device must be a fraction in [0, 1], got "
+                f"{rd!r}")
         # Optional `batch` block (ISSUE 9): multi-tenant serving runs
         # carry the batch size, the serving throughput and the padding
         # tax — tools/perf_regress.py gates jobs_per_s like-for-like
@@ -507,6 +522,15 @@ def run_bench(
             # A/B promotes a dense engine).
             out["coalesce_kernel"] = round(
                 tr_counters.get("coalesce_dense_edges", 0) / co_total, 4)
+        rb_total = tr_counters.get("rebin_phases", 0)
+        if rb_total:
+            # Device-rebin coverage of the coarse bucketed phases
+            # (ISSUE 19): 1.0 = every coarse plan was built on device
+            # (coarsen/rebin.py), 0.0 = every one fell back to the host
+            # BucketPlan.build.  The arm label that keeps device-rebin
+            # and host-rebin records non-comparable in perf_regress.
+            out["rebin_device"] = round(
+                tr_counters.get("rebin_device_phases", 0) / rb_total, 4)
         if res.pallas_coverage is not None:
             # Kernel-coverage fields (schema v3): traversed-edge-weighted
             # fraction that ran the Pallas kernel + per-width hit counts,

@@ -405,6 +405,13 @@ def coalesce(src, dst, w, nv_pad):
 def tiny_row_sort(row):
     # a genuinely non-slab sort, justified inline
     return jax.lax.sort((row,), num_keys=1)  # graftlint: disable=R013 — O(D) per-row sort, not a slab
+
+def rebin_degrees(src, real, nv_pad):
+    # the ISSUE-19 re-binner idiom: histogram + prefix, NO sort —
+    # exactly what this rule's scope exists to keep sort-free
+    deg = jax.ops.segment_sum(real.astype(jnp.int32), src,
+                              num_segments=nv_pad)
+    return deg, jnp.cumsum(deg) - deg
 """,
         "cuvite_tpu/coarsen/fake_r013.py",
     ),
@@ -466,6 +473,15 @@ def one_off(job, nv_pad):
 def justified(jobs, nv_pad):
     for job in jobs:
         yield BucketPlan.build(job.src, job.dst, job.w, nv_local=nv_pad, base=0)  # graftlint: disable=R015 — diagnostic path, not dispatch
+
+def coarse_dispatch(batches, nv_pad, geometry):
+    from cuvite_tpu.coarsen.rebin import device_rebin_plan
+
+    # the sanctioned in-loop planner (ISSUE 19): coarse phases re-bin
+    # ON DEVICE inside the compiled program — not a host plan per job
+    for b in batches:
+        yield device_rebin_plan(b.src, b.dst, b.w, nv_pad=nv_pad,
+                                base=0, geometry=geometry)
 """,
         "cuvite_tpu/serve/fake_r015.py",
     ),

@@ -269,16 +269,24 @@ def test_bucketed_matches_fused_engine(jobs, bucketed_result):
         assert rb.total_iterations == rf.total_iterations
 
 
-def test_bucketed_phase_engine_telemetry(bucketed_result):
-    """Phase 0 records the bucketed engine, coarse phases the fused
-    loop, and the one-notch serving-coarse shrink is reported."""
+def test_bucketed_phase_engine_telemetry(bucketed_result, monkeypatch):
+    """Phase 0 records the bucketed engine, coarse phases the device
+    re-binned bucketed loop (ISSUE 19 — the serving class is
+    rebin-eligible, so no coarse phase falls back to fused), and the
+    one-notch serving-coarse shrink is reported.  Pinning
+    CUVITE_DEVICE_REBIN=0 restores the fused downgrade."""
     eng = bucketed_result.phase_engines
     assert eng[0] == "bucketed"
-    assert all(e == "fused" for e in eng[1:]) and len(eng) >= 2
+    assert all(e == "rebinned" for e in eng[1:]) and len(eng) >= 2
     assert bucketed_result.coarse_class == (1024, 4096)
     fused = louvain_many([generate_rmat(8, edge_factor=8, seed=1)])
     assert all(e == "fused" for e in fused.phase_engines)
     assert fused.coarse_class is None
+    monkeypatch.setenv("CUVITE_DEVICE_REBIN", "0")
+    gs = [generate_rmat(8, edge_factor=8, seed=s) for s in (1, 2)]
+    off = louvain_many(gs, engine="bucketed")
+    assert off.phase_engines[0] == "bucketed"
+    assert all(e == "fused" for e in off.phase_engines[1:])
 
 
 def test_batch_bucket_plans_geometry(jobs):

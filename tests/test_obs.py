@@ -448,8 +448,8 @@ def _fresh_v4_record():
         modularity=rec.get("modularity", 0.5),
         phases=rec.get("phases", 3),
         compile_guard={"checked": True, "new_compiles": 0},
-        stages={"coarsen_s": 0.0, "coalesce_s": 0.0, "upload_s": 0.0,
-                "iterate_s": 0.0},
+        stages={"coarsen_s": 0.0, "coalesce_s": 0.0, "rebin_s": 0.0,
+                "upload_s": 0.0, "iterate_s": 0.0},
         convergence_summary=[{"iterations": 1}],
         compile_events=[], hbm_peak_by_buffer={})
     return rec

@@ -405,7 +405,7 @@ def test_validate_record_rejects_unchecked_nonzero_compiles():
            "phases": 1, "compile_guard": {"checked": True,
                                           "new_compiles": 2},
            "stages": {"coarsen_s": 0.0, "coalesce_s": 0.0,
-                      "upload_s": 0.0, "iterate_s": 1.0},
+                      "rebin_s": 0.0, "upload_s": 0.0, "iterate_s": 1.0},
            "engine": "bucketed", "schema": 4,
            "convergence_summary": [{"phase": 0, "iterations": 3}],
            "compile_events": [{"module": "jit(f)", "dur_s": 0.5}],

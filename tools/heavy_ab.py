@@ -7,14 +7,17 @@ matmul passes per row — linear in the COMMUNITY-SPACE size — while the
 sort path is O(D log^2 D) per row regardless of nv.  The sweep times
 both over (D, nv_ceil) so the log records where the tile kernel wins.
 
-Sweep 2 (seg-coalesce, `python tools/heavy_ab.py seg`): the dense
-dst-tile coalesce engines (kernels/seg_coalesce.py, 'xla' twin +
-'pallas' kernel) vs the packed-sort chokepoint on relabeled-slab
-workloads across eligible slab classes — the measurement that decides
-whether CUVITE_SEG_COALESCE flips default-on per backend.  The round-7
-config itself (sort engine @ scale 20) is covered by
-tools/fullrun_ab.py with CUVITE_SEG_COALESCE set; this sweep isolates
-the coalesce op.  Appends to tools/logs/seg_coalesce_ab_r10.log.
+Sweep 2 (seg-coalesce, `python tools/heavy_ab.py seg`): the coalesce
+engines vs the packed-sort chokepoint on relabeled-slab workloads, per
+slab class — the dense dst-tile pair (kernels/seg_coalesce.py, 'xla'
+twin + 'pallas' kernel) on its budget-eligible classes, plus the
+ISSUE-19 big-class arms on every class: 'msd' (two-pass int32 MSD
+src-partition sort) and 'hash' (hash-slot accumulate with device-side
+collision detection + sort retry).  The nv_pad >= 2^16 classes are the
+ones the round-10 baseline showed paying the 64-bit variadic
+comparator tax — the msd/hash cells there are the ISSUE-19 acceptance
+measurement.  Every cell asserts bit-identity vs the sort oracle
+before timing.  Appends to tools/logs/seg_coalesce_ab_r19.log.
 
 Usage:
     python tools/heavy_ab.py                   # both sweeps (chip)
@@ -38,7 +41,7 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "tools", "logs", "heavy_ab_r5.log")
-SEG_LOG = os.path.join(REPO, "tools", "logs", "seg_coalesce_ab_r10.log")
+SEG_LOG = os.path.join(REPO, "tools", "logs", "seg_coalesce_ab_r19.log")
 
 
 def _log_to(path, msg):
@@ -75,13 +78,22 @@ def seg_coalesce_ab():
                      f"interpret={interpret}")
     rng = np.random.default_rng(11)
     for nv_pad, ne_pad in ((1024, 1 << 17), (4096, 1 << 18),
-                           (4096, 1 << 20)):
-        if interpret and ne_pad > (1 << 18):
-            # Interpret mode unrolls the kernel grid at trace time; the
-            # big slabs are chip cases.  The XLA twin still measures.
-            engines = ("sort", "xla")
-        else:
-            engines = ("sort", "xla", "pallas")
+                           (4096, 1 << 20), (1 << 16, 1 << 20),
+                           (1 << 18, 1 << 20)):
+        # msd/hash run on EVERY class: on the small classes msd
+        # delegates to the packed sort (expect ~1.0x, a delegation
+        # check), on the nv_pad >= 2^16 classes they are the ISSUE-19
+        # candidates against the 64-bit comparator tax.
+        engines = ["sort", "msd", "hash"]
+        if nv_pad <= 4096:
+            # Dense dst-tile classes (within the accumulator budget).
+            engines.insert(1, "xla")
+            if not (interpret and ne_pad > (1 << 18)):
+                # Interpret mode unrolls the kernel grid at trace time;
+                # the big slabs are chip cases.  The XLA twin still
+                # measures.
+                engines.insert(2, "pallas")
+        engines = tuple(engines)
         n_real = ne_pad - ne_pad // 5
         src = np.full(ne_pad, nv_pad, np.int32)
         dst = np.zeros(ne_pad, np.int32)

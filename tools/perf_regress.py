@@ -44,8 +44,11 @@ if REPO_ROOT not in sys.path:  # validate_v4's lazy cuvite_tpu import
 TEPS_METRIC = "louvain_teps_per_chip"
 # coalesce_s (ISSUE 8) is the device relabel+coalesce slice nested
 # inside coarsen_s — gating it separately catches a sort-tax regression
-# that a constant-ish coarsen_s total would mask.
-STAGE_KEYS = ("coarsen_s", "coalesce_s", "upload_s", "iterate_s")
+# that a constant-ish coarsen_s total would mask.  rebin_s (ISSUE 19)
+# is the device plan re-bin of coarse bucketed phases, nested inside
+# plan_s the same way.
+STAGE_KEYS = ("coarsen_s", "coalesce_s", "rebin_s", "upload_s",
+              "iterate_s")
 
 
 def load_trajectory(pattern: str) -> list:
@@ -184,6 +187,15 @@ def comparable(fresh: dict, rec: dict) -> bool:
             for k in ("dcn", "ici"):
                 if fx.get(k) != rx.get(k):
                     return False
+    # Re-bin arms (ISSUE 19): a device-rebin record (rebin_device > 0)
+    # never gates a host-rebin one or vice versa — the device arm moves
+    # per-phase plan cost from host BucketPlan.build + upload into
+    # rebin_s by design, so cross-arm stage deltas are architecture,
+    # not regression.  Records predating the field (or non-bucketed
+    # engines, which never re-bin) compare only against each other.
+    frd, rrd = fresh.get("rebin_device"), rec.get("rebin_device")
+    if (frd is not None and frd > 0) != (rrd is not None and rrd > 0):
+        return False
     return True
 
 

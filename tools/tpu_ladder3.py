@@ -529,10 +529,11 @@ def main():
         log(f"E: FAILED {type(e).__name__}: {e}")
     # Heavy-class decision measurement (heavy_kernel_design.md): tile
     # kernel vs XLA sorted path over (D, nv_ceil); its own dated log.
-    # `both` also runs the ISSUE 8 seg-coalesce sweep (dense dst-tile
-    # engines vs the packed-sort chokepoint, per slab class —
-    # tools/logs/seg_coalesce_ab_r10.log): the on-chip number that
-    # decides whether CUVITE_SEG_COALESCE flips default-on.
+    # `both` also runs the seg-coalesce sweep (ISSUE 8 dense dst-tile
+    # engines + the ISSUE 19 msd/hash big-class engines vs the
+    # packed-sort chokepoint, per slab class —
+    # tools/logs/seg_coalesce_ab_r19.log): the on-chip numbers that
+    # decide the CUVITE_SEG_COALESCE per-backend defaults.
     try:
         subprocess.run([sys.executable,
                         os.path.join(REPO, "tools", "heavy_ab.py"),
@@ -540,17 +541,21 @@ def main():
                        timeout=1800)
     except subprocess.TimeoutExpired:
         log("heavy_ab: TIMEOUT (1800s)")
-    # Stage F (ISSUE 8): round-7 config end-to-end with the dense
-    # coalesce forced vs default — the fullrun side of the seg-coalesce
-    # A/B, on-chip.
-    try:
-        env = dict(os.environ, AB_SCALE="20", AB_ENGINE="sort",
-                   CUVITE_SEG_COALESCE="xla")
-        subprocess.run([sys.executable,
-                        os.path.join(REPO, "tools", "fullrun_ab.py")],
-                       timeout=3600, env=env)
-    except subprocess.TimeoutExpired:
-        log("fullrun_ab (seg-coalesce stage F): TIMEOUT (3600s)")
+    # Stage F (ISSUE 8, extended by ISSUE 19): round-7 config
+    # end-to-end with each coalesce engine forced — the fullrun side of
+    # the seg-coalesce A/B, on-chip.  'xla' is the dense dst-tile arm;
+    # 'msd' and 'hash' are the big-class sort-free arms (at scale 20
+    # the nv_pad >= 2^16 coarse slabs are where they differ from sort).
+    for seg_eng in ("xla", "msd", "hash"):
+        try:
+            env = dict(os.environ, AB_SCALE="20", AB_ENGINE="sort",
+                       CUVITE_SEG_COALESCE=seg_eng)
+            subprocess.run([sys.executable,
+                            os.path.join(REPO, "tools", "fullrun_ab.py")],
+                           timeout=3600, env=env)
+        except subprocess.TimeoutExpired:
+            log(f"fullrun_ab (seg-coalesce stage F, {seg_eng}): "
+                "TIMEOUT (3600s)")
     # Stage G (ISSUE 9): batched serving at B in {1, 8, 64}.
     try:
         stage_g()
