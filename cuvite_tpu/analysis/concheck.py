@@ -633,7 +633,8 @@ class DaemonScenario:
                  max_retries: int = 2, retry_base_s: float = 0.05,
                  pipelined: bool = False, pack_hold_s: float = 0.0,
                  delta_tenants: int = 0, deltas_each: int = 0,
-                 stream_budget_bytes: int | None = None):
+                 stream_budget_bytes: int | None = None,
+                 merge_packing: bool = False, big_jobs: int = 0):
         self.name = name
         self.n_intake = n_intake
         self.jobs_each = jobs_each
@@ -654,6 +655,16 @@ class DaemonScenario:
         self.delta_tenants = delta_tenants
         self.deltas_each = deltas_each
         self.stream_budget_bytes = stream_budget_bytes
+        # Merge-aware packer arm (ISSUE 20): an extra intake thread
+        # submits ``big_jobs`` larger-class graphs; once a plain big
+        # batch completes, overflowing small bins may pop PAST b_max
+        # and dispatch merged.  ``merged_batches_seen`` accumulates
+        # across schedules so the tier-1 test can assert the scenario
+        # actually exercises the merge path (teeth), not just that it
+        # stays clean.
+        self.merge_packing = merge_packing
+        self.big_jobs = big_jobs
+        self.merged_batches_seen = 0
         self.inventory = None   # filled by explore()/run_schedule()
 
     def setup(self, sched) -> dict:
@@ -665,6 +676,7 @@ class DaemonScenario:
             ServeConfig(b_max=self.b_max, linger_s=self.linger_s,
                         engine="fused", max_retries=self.max_retries,
                         retry_base_s=self.retry_base_s,
+                        merge_packing=self.merge_packing,
                         stream_budget_bytes=(self.stream_budget_bytes
                                              or 256 << 20)),
             clock=sched.clock, sleep=sched.sleep,
@@ -729,6 +741,18 @@ class DaemonScenario:
             sched.spawn(intake, name=f"intake{i}", args=(
                 client, _graph_reqs(self.jobs_each, f"t{i}",
                                     with_ids=self.with_ids)))
+        if self.big_jobs:
+            # Larger-class intake (ISSUE 20): nv=8192 with ~9k arcs
+            # symmetrizes past the 16384-edge floor, landing in
+            # (8192, 32768) — an exact n_sub=2 sub-row multiple of the
+            # small graphs' (4096, 16384) floor class.  Schedules where
+            # the big plain batch completes before the small bin
+            # overflows dispatch a MERGED small batch; the others serve
+            # plain — conservation/exactly-once must hold in both.
+            sched.spawn(intake, name="intake-big", args=(
+                clients[0], _graph_reqs(self.big_jobs, "big",
+                                        with_ids=self.with_ids,
+                                        nv=8192, ne=9000)))
         for t in range(self.delta_tenants):
             sched.spawn(delta_intake, name=f"delta{t}", args=(
                 clients[0], _delta_reqs(self.deltas_each, f"d{t}")))
@@ -739,6 +763,7 @@ class DaemonScenario:
 
     def check(self, sched, ctx) -> None:
         daemon, server = ctx["daemon"], ctx["server"]
+        self.merged_batches_seen += int(server.stats.merged_batches)
         if not daemon._done.is_set():
             sched.record_failure(
                 "no-drain", "dispatcher never completed the drain")
@@ -951,6 +976,15 @@ def builtin_scenarios() -> dict:
             "delta-vs-drain", n_intake=1, jobs_each=1, delta_tenants=2,
             deltas_each=3, stream_budget_bytes=1500,
             drain_after_s=0.02), "clean"),
+        # ISSUE 20 — the merge-aware packer: three small jobs against
+        # b_max=2 overflow-merge into the big class certified by the
+        # intake-big thread's plain batch.  Merged pops take jobs past
+        # b_max in one dispatch; conservation and exactly-once must
+        # survive every interleaving of the certifying big batch with
+        # the small bin's overflow.
+        "merge-pack-clean": (lambda: DaemonScenario(
+            "merge-pack-clean", n_intake=1, jobs_each=3, with_ids=True,
+            merge_packing=True, big_jobs=2), "clean"),
         "racy-routes": (lambda: DaemonScenario(
             "racy-routes", variant=_racy_route_results), "detect"),
         "send-under-lock": (lambda: DaemonScenario(

@@ -997,6 +997,15 @@ class SlabSortOutsideChokepoint(Rule):
 # of regression a lint must catch, because no test output changes.
 
 _SERVE_SCOPE = ("cuvite_tpu/serve/",)
+# The PACKER path (ISSUE 20): the pack/prepare/unpack stage functions
+# of the batched driver and the slab packers hold the same per-batch
+# amortization contract as the serve/ queue loops — one upload, one
+# plan build, zero jit construction per BATCH, however many tenants a
+# merged sub-row batch carries.  Scope is per-FUNCTION (pack_*,
+# prepare_*, unpack_*), not per-module: the phase loops in the same
+# files legitimately run jax calls per iteration.
+_PACKER_SCOPE = ("cuvite_tpu/louvain/batched.py", "cuvite_tpu/core/batch.py")
+_PACKER_FUNC_PREFIXES = ("pack_", "prepare_", "unpack_")
 _SERVE_LOOP_TRAPS = {
     "jax.jit", "jax.vmap", "jax.pmap",
     "jax.device_put", "jnp.asarray", "jax.numpy.asarray",
@@ -1005,10 +1014,13 @@ _SERVE_LOOP_TRAPS = {
 
 def _serve_loop_calls(sf, names):
     """(node, fname) for every call of ``names`` lexically inside a
-    for/while loop of a serve/ module — the shared traversal of the
-    per-job amortization-trap rules (R014 compile/upload, R015 plan
-    construction), so their loop/scope semantics cannot drift."""
-    if not sf.rel.startswith(_SERVE_SCOPE):
+    for/while loop of a serve/ module, or of a packer-path function
+    (pack_*/prepare_*/unpack_* in the batched driver and slab packer)
+    — the shared traversal of the per-job amortization-trap rules
+    (R014 compile/upload, R015 plan construction), so their loop/scope
+    semantics cannot drift."""
+    in_serve = sf.rel.startswith(_SERVE_SCOPE)
+    if not in_serve and sf.rel not in _PACKER_SCOPE:
         return
     seen: set = set()
     for loop in sf.walk():
@@ -1019,6 +1031,11 @@ def _serve_loop_calls(sf, names):
                 continue
             fname = dotted(node.func)
             if fname in names:
+                if not in_serve:
+                    info = sf.enclosing_function(node)
+                    if info is None or not info.name.startswith(
+                            _PACKER_FUNC_PREFIXES):
+                        continue
                 seen.add(id(node))
                 yield node, fname
 

@@ -195,6 +195,75 @@ def batched_coarsen_slab(src, dst, w, comm, real_mask, dense_map, nc, *,
     return jax.vmap(one)(src, dst, w, comm, real_mask, dense_map, nc)
 
 
+# --- sub-row (fenced) lifts, ISSUE 20 --------------------------------------
+# A packed row (core/batch.py::SubRowLayout) holds n_sub disjoint graphs
+# at fixed vertex offsets; its coarsening must renumber SEGMENT-LOCALLY
+# so every sub-row's coarse ids stay inside its own fence interval —
+# whole-row dense ranks would blur the seams for the next phase.  Two
+# maps come out of one presence scan: the CURRENT-offset map relabels
+# the resident slab (whose class may have shrunk), the ORIGINAL-offset
+# map composes the cross-phase labels, which therefore always live in
+# the pack-time offset space — unpack is a fence slice minus the
+# offset, no matter when each sub-row retired or whether the slab
+# shrank in between.
+
+
+@functools.partial(jax.jit, static_argnames=("nv_pad", "n_sub", "nv_sub0"))
+def subrow_renumber(comm, real_mask, *, nv_pad: int, n_sub: int,
+                    nv_sub0: int):
+    """Segment-local dense renumbering of a packed row's surviving
+    communities.  Returns ``(dmap_cur, dmap_orig, nc)``: ``dmap_cur[c]``
+    is community ``c``'s dense id at CURRENT sub-row offsets
+    (``s * (nv_pad // n_sub) + rank``), ``dmap_orig[c]`` the same rank
+    at ORIGINAL offsets (``s * nv_sub0 + rank``), ``nc`` the ``[n_sub]``
+    per-sub-row surviving counts.  Ranks are the within-segment cumsum
+    of the same presence scan :func:`device_renumber` uses, so each
+    sub-row's ranks equal its solo run's (smallest label -> 0)."""
+    lab = jnp.where(real_mask, comm, nv_pad)
+    present = jnp.zeros((nv_pad + 1,), jnp.int32).at[lab].set(1, mode="drop")
+    present = present[:nv_pad].reshape(n_sub, -1)
+    local = jnp.cumsum(present, axis=-1) - present
+    nv_sub = nv_pad // n_sub
+    offs_cur = (jnp.arange(n_sub, dtype=jnp.int32) * nv_sub)[:, None]
+    offs_orig = (jnp.arange(n_sub, dtype=jnp.int32) * nv_sub0)[:, None]
+    dmap_cur = (local + offs_cur).reshape(nv_pad).astype(comm.dtype)
+    dmap_orig = (local + offs_orig).reshape(nv_pad).astype(comm.dtype)
+    return dmap_cur, dmap_orig, jnp.sum(present, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("nv_pad", "n_sub", "nv_sub0"))
+def subrow_compose_labels(dmap_orig, labels, comm_all, *, nv_pad: int,
+                          n_sub: int, nv_sub0: int):
+    """Cross-phase label composition for a packed row: ``comm_all``
+    holds ORIGINAL-offset dense ids; map them to current offsets (the
+    slab class may have shrunk), gather this phase's ``labels``, then
+    back to original offsets through ``dmap_orig``.  Gathers clamp —
+    retired sub-rows' stale ids may exceed the shrunken segment, and
+    their positions are masked out by the caller anyway."""
+    nv_sub = nv_pad // n_sub
+    s = comm_all // nv_sub0
+    r = comm_all % nv_sub0
+    v_cur = jnp.minimum(s, n_sub - 1) * nv_sub + jnp.minimum(r, nv_sub - 1)
+    v_cur = jnp.minimum(v_cur, nv_pad - 1)
+    return jnp.take(dmap_orig, jnp.take(labels, v_cur))
+
+
+def batched_subrow_renumber(comm, real_mask, *, nv_pad: int, n_sub: int,
+                            nv_sub0: int):
+    """[B, nv_pad] lift of :func:`subrow_renumber`."""
+    return jax.vmap(functools.partial(
+        subrow_renumber, nv_pad=nv_pad, n_sub=n_sub, nv_sub0=nv_sub0))(
+        comm, real_mask)
+
+
+def batched_subrow_compose(dmap_orig, labels, comm_all, *, nv_pad: int,
+                           n_sub: int, nv_sub0: int):
+    """[B, ...] lift of :func:`subrow_compose_labels`."""
+    return jax.vmap(functools.partial(
+        subrow_compose_labels, nv_pad=nv_pad, n_sub=n_sub,
+        nv_sub0=nv_sub0))(dmap_orig, labels, comm_all)
+
+
 def shrink_slab(src, dst, w, *, new_nv_pad: int, new_ne_pad: int):
     """Drop a compacted coarse slab to a smaller pow2 class — device ops
     only (a prefix slice plus a padding-sentinel rewrite; real ids are

@@ -202,6 +202,241 @@ def batch_slabs(graphs, *, b_pad: int | None = None,
     )
 
 
+# --- mixed-class sub-row packing (ISSUE 20) --------------------------------
+# Under a skewed serving mix the small class queues behind its own
+# BATCH_SIZES row cap while the big class's rows linger underfull.  A
+# SubRowLayout packs 2^k small-class graphs into ONE row of the
+# k-notches-larger class's slab SHAPES: sub-row s owns the vertex ids
+# [s*nv_sub, (s+1)*nv_sub) and (at pack time) the edge slots
+# [s*ne_sub, (s+1)*ne_sub).  The vertex-offset algebra IS the fence:
+# packed graphs share no edges across a seam, community ids start at
+# identity (in-segment) and the Louvain move step only ever proposes
+# NEIGHBOR communities, so no id can cross a seam at any phase — which
+# is what makes per-tenant labels bit-identical to the B=1 run by
+# construction (louvain/subrow.py carries the per-sub-row constants,
+# Q and convergence masks through the compiled loop).
+
+
+@dataclasses.dataclass(frozen=True)
+class SubRowLayout:
+    """Static sub-row geometry of a packed row: the ONLY layout fact
+    that may enter a compile key (``n_sub`` — which tenants occupy
+    which sub-row is batch CONTENT and must never become a static)."""
+
+    n_sub: int        # pow2 >= 2 sub-rows per packed row
+    sub_class: tuple  # (nv_sub, ne_sub) — the small class being packed
+
+    def __post_init__(self):
+        n = self.n_sub
+        if n < 2 or (n & (n - 1)):
+            raise ValueError(f"SubRowLayout: n_sub={n} must be a pow2 >= 2")
+
+    @property
+    def nv_sub(self) -> int:
+        return int(self.sub_class[0])
+
+    @property
+    def ne_sub(self) -> int:
+        return int(self.sub_class[1])
+
+    @property
+    def row_class(self) -> tuple:
+        """The packed row's slab class: exactly ``n_sub`` times the sub
+        class in BOTH dimensions (the "ne_pad differs by exactly the
+        class ratio" rule — pow2 classes make the ratio exact)."""
+        return (self.n_sub * self.nv_sub, self.n_sub * self.ne_sub)
+
+    def vertex_offset(self, s: int) -> int:
+        return s * self.nv_sub
+
+    def edge_offset(self, s: int) -> int:
+        return s * self.ne_sub
+
+    def vertex_fences(self) -> tuple:
+        """The ``n_sub + 1`` vertex-id seam boundaries; sub-row ``s``
+        owns ids in ``[fences[s], fences[s+1])``.  Community ids of a
+        packed row must stay inside their sub-row's fence interval at
+        every phase (tests/test_subrow.py pins this adversarially)."""
+        return tuple(s * self.nv_sub for s in range(self.n_sub + 1))
+
+
+def subrow_layout_for(sub_class: tuple, row_class: tuple) -> SubRowLayout | None:
+    """The layout packing ``sub_class`` rows into ``row_class`` rows, or
+    None when the classes are not an exact pow2 ratio in BOTH dimensions
+    (per-dimension ratios that disagree cannot fence cleanly)."""
+    nv_s, ne_s = sub_class
+    nv_r, ne_r = row_class
+    if nv_s <= 0 or ne_s <= 0 or nv_r % nv_s or ne_r % ne_s:
+        return None
+    n = nv_r // nv_s
+    if n < 2 or (n & (n - 1)) or ne_r // ne_s != n:
+        return None
+    return SubRowLayout(n_sub=n, sub_class=(int(nv_s), int(ne_s)))
+
+
+@dataclasses.dataclass
+class PackedSubRows:
+    """B packed rows of ``layout.row_class``, each holding up to
+    ``layout.n_sub`` small-class graphs at the layout's offsets.
+
+    Slab conventions match :class:`BatchedSlab` at the ROW class (src
+    padding sentinel == row nv_pad, dst/w pad 0) so the packed batch
+    flows through the same upload/mesh machinery; everything per-GRAPH
+    (constants, real counts, validity) is ``[b_pad, n_sub]``.  Jobs
+    occupy sub-rows in row-major order: job j sits at
+    ``(j // n_sub, j % n_sub)``."""
+
+    src: np.ndarray        # [b_pad, ne_pad] int32 (row class)
+    dst: np.ndarray        # [b_pad, ne_pad] int32
+    w: np.ndarray          # [b_pad, ne_pad] float32
+    real_mask: np.ndarray  # [b_pad, nv_pad] bool
+    constants: np.ndarray  # [b_pad, n_sub] 1/(2m) per sub-row (0 on pads)
+    sub_valid: np.ndarray  # [b_pad, n_sub] bool
+    nv_real: np.ndarray    # [b_pad, n_sub] int64
+    ne_real: np.ndarray    # [b_pad, n_sub] int64
+    tw2: np.ndarray        # [b_pad, n_sub] float64
+    layout: SubRowLayout
+    n_jobs: int
+
+    @property
+    def b_pad(self) -> int:
+        return int(self.src.shape[0])
+
+    @property
+    def nv_pad(self) -> int:
+        return int(self.layout.row_class[0])
+
+    @property
+    def ne_pad(self) -> int:
+        return int(self.layout.row_class[1])
+
+    @property
+    def slab_class(self) -> tuple:
+        return self.layout.row_class
+
+    @property
+    def row_valid(self) -> np.ndarray:
+        return self.sub_valid.any(axis=1)
+
+    @property
+    def pack_util(self) -> float:
+        """Fraction of batch ROWS carrying at least one real job."""
+        return float(self.row_valid.sum()) / max(self.b_pad, 1)
+
+    @property
+    def subrow_util(self) -> float:
+        """Real graphs over TOTAL sub-row capacity — the honest
+        occupancy of a merged batch (``pack_util`` saturates at 1.0 the
+        moment every row holds one tenant)."""
+        return self.n_jobs / max(self.b_pad * self.layout.n_sub, 1)
+
+
+def pack_subrows(graphs, layout: SubRowLayout, *,
+                 b_pad: int | None = None) -> PackedSubRows:
+    """Pack small-class graphs into sub-rows of ``layout.row_class``
+    rows (job j -> row ``j // n_sub``, sub-row ``j % n_sub``).
+
+    Every graph must canonicalize INTO ``layout.sub_class`` (its own
+    class may be smaller — it pads up, exactly as a pinned
+    :func:`batch_slabs` class would).  Each sub-row is built by the SAME
+    ``DistGraph.build`` call its solo slab uses, then embedded at the
+    layout offsets with vertex ids shifted by ``vertex_offset(s)`` and
+    its padding edges rewritten to the ROW sentinel — the only
+    transformations are an id shift and a sentinel rename, which is the
+    fence-construction half of the bit-identity argument."""
+    if not graphs:
+        raise ValueError("pack_subrows: empty graph list")
+    nv_sub, ne_sub = layout.sub_class
+    nv_pad, ne_pad = layout.row_class
+    n_sub = layout.n_sub
+    too_big = [c for c in sorted({slab_class_of(g) for g in graphs})
+               if c[0] > nv_sub or c[1] > ne_sub]
+    if too_big:
+        raise ValueError(
+            f"pack_subrows: graphs of classes {too_big} do not fit the "
+            f"sub class {layout.sub_class}")
+
+    import jax
+
+    if jax.config.jax_enable_x64 and any(
+            np.dtype(g.policy.weight_dtype) == np.float64 for g in graphs):
+        raise ValueError(
+            "pack_subrows: wide-policy (f64-weight) graphs under "
+            "jax_enable_x64 keep f64 on the per-graph drivers — serve "
+            "them through louvain_phases (same refusal as batch_slabs)")
+
+    n = len(graphs)
+    rows = -(-n // n_sub)
+    bp = batch_pad(rows) if b_pad is None else int(b_pad)
+    if bp < rows:
+        raise ValueError(f"pack_subrows: b_pad={bp} < {rows} packed rows")
+    wdt = np.dtype(np.float32)
+    src = np.full((bp, ne_pad), nv_pad, dtype=np.int32)
+    dst = np.zeros((bp, ne_pad), dtype=np.int32)
+    w = np.zeros((bp, ne_pad), dtype=wdt)
+    real_mask = np.zeros((bp, nv_pad), dtype=bool)
+    constants = np.zeros((bp, n_sub), dtype=wdt)
+    sub_valid = np.zeros((bp, n_sub), dtype=bool)
+    nv_real = np.zeros((bp, n_sub), dtype=np.int64)
+    ne_real = np.zeros((bp, n_sub), dtype=np.int64)
+    tw2 = np.zeros((bp, n_sub), dtype=np.float64)
+
+    for j, g in enumerate(graphs):
+        i, s = j // n_sub, j % n_sub
+        dg = DistGraph.build(g, 1, min_nv_pad=nv_sub, min_ne_pad=ne_sub)
+        assert (dg.nv_pad, dg.ne_pad) == (nv_sub, ne_sub)
+        sh = dg.shards[0]
+        voff, eoff = layout.vertex_offset(s), layout.edge_offset(s)
+        s_src = np.asarray(sh.src, dtype=np.int32)
+        s_dst = np.asarray(sh.dst, dtype=np.int32)
+        s_w = np.asarray(sh.w, dtype=wdt)
+        pad = s_src >= nv_sub
+        # Real edges shift into the sub-row's fence interval; the sub
+        # slab's padding rows rename their sentinel to the ROW sentinel
+        # (dst/w already carry the 0-pad convention).
+        src[i, eoff:eoff + ne_sub] = np.where(
+            pad, np.int32(nv_pad), s_src + np.int32(voff))
+        dst[i, eoff:eoff + ne_sub] = np.where(pad, 0, s_dst + np.int32(voff))
+        w[i, eoff:eoff + ne_sub] = np.where(pad, wdt.type(0), s_w)
+        real_mask[i, voff:voff + nv_sub] = dg.vertex_mask()
+        t2 = g.total_edge_weight_twice()
+        if t2 <= 0:
+            raise ValueError(
+                f"pack_subrows: graph {j} has no edge weight (edgeless "
+                "graphs short-circuit before packing, as in louvain_many)")
+        constants[i, s] = wdt.type(1.0 / t2)
+        sub_valid[i, s] = True
+        nv_real[i, s] = g.num_vertices
+        ne_real[i, s] = g.num_edges
+        tw2[i, s] = t2
+
+    return PackedSubRows(
+        src=src, dst=dst, w=w, real_mask=real_mask, constants=constants,
+        sub_valid=sub_valid, nv_real=nv_real, ne_real=ne_real, tw2=tw2,
+        layout=layout, n_jobs=n,
+    )
+
+
+def unpack_subrows(packed: PackedSubRows, comm_all: np.ndarray,
+                   prev_mod: np.ndarray):
+    """Per-tenant label/Q extraction from a packed run's final state:
+    ``comm_all`` [b_pad, nv_pad] composed labels in ORIGINAL layout
+    offsets, ``prev_mod`` [b_pad, n_sub] per-sub-row Q.  Returns a list
+    of ``(labels int64 [nv_real], q float)`` in job order — labels are
+    the sub-row slice minus its vertex offset, exactly the prefix-slice
+    unpack of the plain batched driver shifted by the fence base."""
+    out = []
+    lay = packed.layout
+    for j in range(packed.n_jobs):
+        i, s = j // lay.n_sub, j % lay.n_sub
+        voff = lay.vertex_offset(s)
+        nv = int(packed.nv_real[i, s])
+        labels = np.asarray(
+            comm_all[i, voff:voff + nv], dtype=np.int64) - voff
+        out.append((labels, float(prev_mod[i, s])))
+    return out
+
+
 # --- batched bucket plans (ISSUE 10) ---------------------------------------
 # The fused batched program sweeps via the packed 2-channel lax.sort — the
 # exact per-row cost the per-graph bucketed engine exists to avoid.  To run

@@ -65,8 +65,15 @@ from cuvite_tpu.coarsen.device import (
     batched_coarsen_slab,
     batched_compose_labels,
     batched_renumber,
+    batched_subrow_compose,
+    batched_subrow_renumber,
 )
-from cuvite_tpu.core.batch import BATCH_ENGINES, BatchedSlab, batch_slabs
+from cuvite_tpu.core.batch import (
+    BATCH_ENGINES,
+    BatchedSlab,
+    PackedSubRows,
+    batch_slabs,
+)
 from cuvite_tpu.core.types import (
     MAX_TOTAL_ITERATIONS,
     TERMINATION_PHASE_COUNT,
@@ -229,6 +236,106 @@ def _rebinned_phase_body(src, dst, w, comm_all, real_mask, prev_mod,
         nv_pad=nv_pad, accum_dtype=accum_dtype, coalesce=coalesce)
 
 
+def _subrow_phase_body(src, dst, w, comm_all, real_mask, prev_mod, active,
+                       constants, threshold, *, nv_pad, n_sub, accum_dtype,
+                       coalesce, max_iters=MAX_TOTAL_ITERATIONS):
+    """The PACKED phase (ISSUE 20): ``n_sub`` fenced small graphs per
+    row, the whole batch through the vmapped sub-row sweep
+    (louvain/subrow.py).  Same 9-operand contract as :func:`_phase_body`
+    except everything per-GRAPH is ``[B, n_sub]`` instead of ``[B]``:
+    ``prev_mod``/``active``/``constants`` in, and the tail's
+    ``(gained, mod, iters, nc, ne2)`` out (telemetry ``cq``/``cmoved``
+    are ``[B, n_sub, CAP]``).  ``n_sub`` is the STATIC layout class —
+    which tenants occupy which sub-row is batch content and never
+    reaches a static (the B002 audit pins this for a packed batch).
+
+    ``comm_all`` keeps the ORIGINAL row width even after the slab
+    class shrinks — its trailing dim fixes the pack-time ``nv_sub0``
+    for the two-offset-space coarsening (coarsen/device.py)."""
+    from cuvite_tpu.louvain.subrow import subrow_phase
+
+    past, mod, iters, _ovf, (cq, cmoved, covf) = jax.vmap(
+        lambda s, d, ww, c: subrow_phase(
+            s, d, ww, c, threshold, nv_pad=nv_pad, n_sub=n_sub,
+            accum_dtype=accum_dtype, max_iters=max_iters)
+    )(src, dst, w, constants)
+
+    return _subrow_phase_tail(
+        src, dst, w, comm_all, real_mask, prev_mod, active, threshold,
+        past, mod, iters, cq, cmoved, covf,
+        nv_pad=nv_pad, n_sub=n_sub, coalesce=coalesce)
+
+
+def _subrow_phase_tail(src, dst, w, comm_all, real_mask, prev_mod, active,
+                       threshold, past, mod, iters, cq, cmoved, covf, *,
+                       nv_pad, n_sub, coalesce):
+    """Phase epilogue of the packed engine: the gain test, coarsening
+    and masked exit of :func:`_phase_tail`, all at SUB-row granularity.
+    Retired sub-rows' edges are masked to the row sentinel BEFORE the
+    whole-row coalesce (so they compact away and batch-mates inherit a
+    pure padding tail), and ``comm_all`` is composed through the
+    ORIGINAL-offset dense map so final labels always live in pack-time
+    offsets — unpack stays a fence slice regardless of when each
+    sub-row retired or whether the slab class shrank."""
+    wdt = w.dtype
+    nv_sub = nv_pad // n_sub
+    nv_sub0 = comm_all.shape[-1] // n_sub
+    mod = mod.astype(wdt)
+    gained = active & ((mod - prev_mod) > threshold)      # [B, n_sub]
+
+    dmap_cur, dmap_orig, nc = batched_subrow_renumber(
+        past, real_mask, nv_pad=nv_pad, n_sub=n_sub, nv_sub0=nv_sub0)
+    comm_all2 = batched_subrow_compose(
+        dmap_orig, past, comm_all, nv_pad=nv_pad, n_sub=n_sub,
+        nv_sub0=nv_sub0)
+
+    # Pre-coalesce retire: non-gaining sub-rows' edges -> row sentinel.
+    seg_e = jnp.minimum(jnp.minimum(src, nv_pad - 1) // nv_sub, n_sub - 1)
+    keep = (src < nv_pad) & jnp.take_along_axis(gained, seg_e, axis=1)
+    src_m = jnp.where(keep, src, jnp.asarray(nv_pad, src.dtype))
+    dst_m = jnp.where(keep, dst, jnp.zeros_like(dst))
+    w_m = jnp.where(keep, w, jnp.zeros_like(w))
+
+    # Relabel through the CURRENT-offset segment-local map + whole-row
+    # coalesce — the device_coarsen_slab body with subrow maps (fences
+    # keep every run single-sub-row, so run sums are bit-identical to
+    # the solo slab's).  Packed rows are f32-only: accum stays None.
+    def one(s, d, ww, c, dm):
+        pad = s >= nv_pad
+        cs = jnp.take(dm, jnp.take(c, jnp.minimum(s, nv_pad - 1)))
+        cd = jnp.take(dm, jnp.take(c, d))
+        ns = jnp.where(pad, jnp.asarray(nv_pad, s.dtype), cs.astype(s.dtype))
+        nd = jnp.where(pad, jnp.zeros((), d.dtype), cd.astype(d.dtype))
+        wi = jnp.where(pad, jnp.zeros_like(ww), ww)
+        s2, d2, w2, _ = seg.coalesced_runs(
+            ns, nd, wi, nv_pad=nv_pad, accum_dtype=None, engine=coalesce)
+        return s2, d2, w2.astype(wdt)
+
+    src2, dst2, w2 = jax.vmap(one)(src_m, dst_m, w_m, past, dmap_cur)
+
+    # Per-sub-row coarse edge count (the shrink decision's ne2).
+    seg2 = jnp.minimum(jnp.minimum(src2, nv_pad - 1) // nv_sub, n_sub - 1)
+    ne2 = jax.vmap(
+        lambda sid, rr: seg.segment_sum(rr, sid, num_segments=n_sub)
+    )(seg2, (src2 < nv_pad).astype(jnp.int32))
+
+    # Masked per-SUB-row exit: gaining sub-rows advance to per-segment
+    # real-mask prefixes; retired ones go dark (labels already frozen
+    # in comm_all at original offsets).
+    segv = jnp.arange(nv_pad, dtype=jnp.int32) // nv_sub
+    rloc = jnp.arange(nv_pad, dtype=jnp.int32) % nv_sub
+    rm_o = (rloc[None, :] < jnp.take(nc, segv, axis=1)) \
+        & jnp.take(gained, segv, axis=1)
+    segp = jnp.arange(comm_all.shape[-1], dtype=jnp.int32) // nv_sub0
+    gp = jnp.take(gained, segp, axis=1)
+    comm_all_o = jnp.where(gp, comm_all2, comm_all)
+    lower = jnp.asarray(-1.0, dtype=wdt)
+    prev_o = jnp.where(gained, jnp.maximum(mod, lower), prev_mod)
+
+    return (src2, dst2, w2, comm_all_o, rm_o, prev_o,
+            gained, mod, iters, nc, ne2, cq, cmoved, covf)
+
+
 def _phase_tail(src, dst, w, comm_all, real_mask, prev_mod, active,
                 threshold, past, mod, iters, cq, cmoved, covf, *,
                 nv_pad, accum_dtype, coalesce):
@@ -325,6 +432,35 @@ def _shrink_batch(src, dst, w, real_mask, *, cnv: int, cne: int):
     return s, dst[:, :cne], w[:, :cne], real_mask[:, :cnv]
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("n_sub", "nv_sub", "cnv_sub", "cne_sub"))
+def _shrink_subrow_batch(src, dst, w, real_mask, *, n_sub: int,
+                         nv_sub: int, cnv_sub: int, cne_sub: int):
+    """Sub-row analog of :func:`_shrink_batch`: every FENCE interval
+    shrinks from ``nv_sub`` to ``cnv_sub`` vertices, so dense coarse ids
+    remap ``s*nv_sub + r -> s*cnv_sub + r`` (each sub-row's ids are
+    dense < its nc <= cnv_sub, so the remap is exact) and the real mask
+    keeps each segment's prefix.  Edges slice to the row prefix — the
+    coalesce compacts real runs there and the caller's per-sub-row ne2
+    gate bounds their total by ``n_sub * cne_sub``.  ``comm_all`` is
+    NOT remapped: it lives in pack-time offsets by construction."""
+    nv_pad = n_sub * nv_sub
+    cnv = n_sub * cnv_sub
+    cne = n_sub * cne_sub
+
+    def remap(x):
+        return ((x // nv_sub) * cnv_sub
+                + jnp.minimum(x % nv_sub, cnv_sub - 1)).astype(x.dtype)
+
+    s = src[:, :cne]
+    s = jnp.where(s >= nv_pad, jnp.asarray(cnv, s.dtype),
+                  remap(jnp.minimum(s, nv_pad - 1)))
+    d = remap(dst[:, :cne])
+    B = real_mask.shape[0]
+    rm = real_mask.reshape(B, n_sub, nv_sub)[:, :, :cnv_sub]
+    return s, d, w[:, :cne], rm.reshape(B, cnv)
+
+
 # Compiled batched-phase programs, keyed by (mesh devices, statics) —
 # the "one compile per (class, B)" cache.  jax.jit already caches per
 # callable+shapes; this table keeps the CALLABLE identity stable across
@@ -333,28 +469,38 @@ _PHASE_CACHE: dict = {}
 
 
 def _get_batched_phase(mesh, nv_pad, accum_dtype, coalesce, max_iters,
-                       engine: str = "fused", n_buckets: int = 0):
+                       engine: str = "fused", n_buckets: int = 0,
+                       n_sub: int = 0):
     """The compiled batched-phase program for one ``(mesh, class
     statics, engine)`` — ``engine='bucketed'`` adds the plan pytree
     (``n_buckets`` triples + heavy/self_loop/perm) ahead of the slab
     state; ``engine='rebinned'`` keeps the fused 9-operand signature
-    (its plan is built inside the program).  jax.jit still caches per
-    shapes, so a bucketed program is one compile per (class, B, bucket
-    geometry)."""
+    (its plan is built inside the program); ``engine='subrow'`` also
+    keeps it, with the per-graph operands widened to ``[B, n_sub]``
+    (ISSUE 20 — ``n_sub`` is the static LAYOUT class; sub-row occupancy
+    stays batch content).  jax.jit still caches per shapes, so a
+    bucketed program is one compile per (class, B, bucket geometry)."""
     key = (
         None if mesh is None else tuple(d.id for d in mesh.devices.flat),
         nv_pad, accum_dtype, coalesce, max_iters, engine, n_buckets,
+        n_sub,
     )
     fn = _PHASE_CACHE.get(key)
     if fn is not None:
         return fn
     bucketed = engine == "bucketed"
-    body = functools.partial(
-        {"bucketed": _bucketed_phase_body,
-         "rebinned": _rebinned_phase_body,
-         "fused": _phase_body}[engine],
-        nv_pad=nv_pad, accum_dtype=accum_dtype,
-        coalesce=coalesce, max_iters=max_iters)
+    if engine == "subrow":
+        body = functools.partial(
+            _subrow_phase_body, nv_pad=nv_pad, n_sub=n_sub,
+            accum_dtype=accum_dtype, coalesce=coalesce,
+            max_iters=max_iters)
+    else:
+        body = functools.partial(
+            {"bucketed": _bucketed_phase_body,
+             "rebinned": _rebinned_phase_body,
+             "fused": _phase_body}[engine],
+            nv_pad=nv_pad, accum_dtype=accum_dtype,
+            coalesce=coalesce, max_iters=max_iters)
     if mesh is None:
         fn = jax.jit(body)
     else:
@@ -429,10 +575,22 @@ class BatchResult:
     # dispatcher overlaps (steady-state batch period = max, not sum).
     pack_s: float = 0.0
     device_s: float = 0.0
+    # Sub-rows per batch row (ISSUE 20): 1 for plain batches, the
+    # layout's n_sub for a packed batch (phase_engines then reads
+    # ['subrow', ...]).
+    n_sub: int = 1
 
     @property
     def pack_util(self) -> float:
-        return self.n_jobs / max(self.b_pad, 1)
+        """Row occupancy — saturates at 1.0 the moment every row holds
+        one tenant; see ``subrow_util`` for merged-batch honesty."""
+        return min(self.n_jobs, self.b_pad) / max(self.b_pad, 1)
+
+    @property
+    def subrow_util(self) -> float:
+        """Real graphs over total SUB-row capacity (== pack_util for
+        plain batches, where n_sub == 1)."""
+        return self.n_jobs / max(self.b_pad * self.n_sub, 1)
 
     @property
     def jobs_per_s(self) -> float:
@@ -521,6 +679,11 @@ class PreparedBatch:
     plan_d: object = None
     # Host pack + upload wall seconds (the packer-stage cost).
     pack_s: float = 0.0
+    # Sub-row layout (engine='subrow', ISSUE 20): n_sub > 1 widens the
+    # per-graph metadata — nv_real/ne_real/sub_valid and the prev/const
+    # device refs are [B, n_sub]; row_valid stays the [B] row-level OR.
+    n_sub: int = 1
+    sub_valid: np.ndarray | None = None
 
 
 def prepare_batch(batch: BatchedSlab, *, mesh="auto", engine: str = "fused",
@@ -606,6 +769,77 @@ def prepare_batch(batch: BatchedSlab, *, mesh="auto", engine: str = "fused",
     )
 
 
+def prepare_packed(packed: PackedSubRows, *, mesh="auto",
+                   tracer=None) -> PreparedBatch:
+    """The PACK half of a sub-row merged batch (ISSUE 20): accumulator
+    gate + mesh resolve + device upload, the packed analog of
+    :func:`prepare_batch` (``engine='subrow'``, no plans).  The gate
+    re-evaluates every tenant's accumulator class AT THE ROW CLASS —
+    ``accum_class_of(g, nv_pad=row_nv_pad)`` — because the packed
+    program's reductions run over the row's padded length: a tenant f32
+    at its own class can cross the ds32 scale gate at the row class, and
+    a per-program accumulator flip would change its batch-mates' bits.
+    The serving merge packer applies the same gate before merging; this
+    raise is the backstop for direct callers."""
+    from cuvite_tpu.louvain.driver import _accum_name
+
+    if tracer is None:
+        from cuvite_tpu.utils.trace import NullTracer
+
+        tracer = NullTracer()
+
+    t0 = time.perf_counter()
+    B = packed.b_pad
+    nv_pad = packed.nv_pad
+    n_sub = packed.layout.n_sub
+    wdt = np.dtype(np.float32)
+    bad = sorted({
+        _accum_name(np.float32, float(packed.tw2[i, s]),
+                    max(int(packed.ne_real[i, s]), nv_pad))
+        for i in range(B) for s in range(n_sub) if packed.sub_valid[i, s]
+    } - {"float32"})
+    if bad:
+        raise ValueError(
+            f"prepare_packed: accumulator classes {bad} at the row "
+            f"class nv_pad={nv_pad} — packed rows are f32-only; gate "
+            "tenants with accum_class_of(g, nv_pad=row_nv_pad) before "
+            "merging (serve/queue.py does)")
+    adt = "float32"
+    eng = _batched_coalesce_engine(nv_pad, adt)
+    if mesh == "auto":
+        mesh = make_batch_mesh(B)
+
+    def _place(x):
+        if mesh is None:
+            return to_device(x)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(x, NamedSharding(mesh, P(BATCH_AXIS)))
+
+    with tracer.stage("upload"):
+        src_d = _place(packed.src)
+        dst_d = _place(packed.dst)
+        w_d = _place(packed.w)
+        rm_d = _place(packed.real_mask)
+        const_d = _place(packed.constants)
+        comm_all_d = _place(np.broadcast_to(
+            np.arange(nv_pad, dtype=np.int32)[None, :],
+            (B, nv_pad)).copy())
+        prev_d = _place(np.full((B, n_sub), -1.0, dtype=wdt))
+
+    return PreparedBatch(
+        b_pad=B, nv_pad=nv_pad, ne_pad=packed.ne_pad,
+        n_jobs=packed.n_jobs, slab_class=packed.slab_class,
+        nv_real=packed.nv_real.copy(), ne_real=packed.ne_real.copy(),
+        row_valid=np.asarray(packed.row_valid).copy(),
+        adt=adt, coalesce=eng, mesh=mesh, engine="subrow", n_buckets=0,
+        src_d=src_d, dst_d=dst_d, w_d=w_d, rm_d=rm_d, const_d=const_d,
+        comm_all_d=comm_all_d, prev_d=prev_d,
+        pack_s=time.perf_counter() - t0,
+        n_sub=n_sub, sub_valid=packed.sub_valid.copy(),
+    )
+
+
 def execute_prepared(prep: PreparedBatch, *, threshold: float = 1.0e-6,
                      max_phases: int = TERMINATION_PHASE_COUNT,
                      tracer=None, verbose: bool = False) -> BatchResult:
@@ -619,6 +853,11 @@ def execute_prepared(prep: PreparedBatch, *, threshold: float = 1.0e-6,
         PhaseStats,
         _phase_sync,
     )
+
+    if prep.engine == "subrow":
+        return _execute_subrow(prep, threshold=threshold,
+                               max_phases=max_phases, tracer=tracer,
+                               verbose=verbose)
 
     if tracer is None:
         from cuvite_tpu.utils.trace import NullTracer
@@ -796,6 +1035,152 @@ def execute_prepared(prep: PreparedBatch, *, threshold: float = 1.0e-6,
     )
 
 
+def _execute_subrow(prep: PreparedBatch, *, threshold: float,
+                    max_phases: int, tracer=None,
+                    verbose: bool = False) -> BatchResult:
+    """The EXECUTE half of a packed batch (ISSUE 20): the
+    :func:`execute_prepared` phase loop with every per-graph scalar
+    widened to ``[B, n_sub]`` — per-SUB-row masked exit, the one-notch
+    coarse shrink decided on the MAX over sub-rows still active, and the
+    final gather unpacked per fence (labels slice at the sub-row's
+    pack-time offset, minus the offset).  One host sync per phase, one
+    compiled program per (row class, B, n_sub), re-runnable like the
+    plain path."""
+    from cuvite_tpu.louvain.driver import (
+        LouvainResult,
+        PhaseStats,
+        _phase_sync,
+    )
+
+    if tracer is None:
+        from cuvite_tpu.utils.trace import NullTracer
+
+        tracer = NullTracer()
+
+    t0 = time.perf_counter()
+    B = prep.b_pad
+    n_sub = prep.n_sub
+    nv_pad0 = prep.nv_pad
+    nv_sub0 = nv_pad0 // n_sub
+    cur_nv, cur_ne = nv_pad0, prep.ne_pad
+    coarse_class = None
+    wdt = np.dtype(np.float32)
+    adt = prep.adt
+    mesh = prep.mesh
+
+    phase_fn = _get_batched_phase(
+        mesh, nv_pad0, adt, prep.coalesce, MAX_TOTAL_ITERATIONS,
+        engine="subrow", n_sub=n_sub)
+    src_d, dst_d, w_d = prep.src_d, prep.dst_d, prep.w_d
+    rm_d, const_d = prep.rm_d, prep.const_d
+    comm_all_d, prev_d = prep.comm_all_d, prep.prev_d
+
+    active = prep.sub_valid.copy()                  # [B, n_sub]
+
+    nv_cur = prep.nv_real.astype(np.int64).copy()   # [B, n_sub]
+    ne_cur = prep.ne_real.astype(np.int64).copy()
+    tot_iters = np.zeros((B, n_sub), dtype=np.int64)
+    sub_phases: list = [[[] for _ in range(n_sub)] for _ in range(B)]
+    sub_conv: list = [[[] for _ in range(n_sub)] for _ in range(B)]
+    phase_engines: list = []
+    phase = 0
+
+    while active.any() and phase < max_phases:
+        t1 = time.perf_counter()
+        active_at_start = active.copy()
+        phase_engines.append("subrow")
+        tracer.ledger_phase_begin()
+        tracer.track("slab", src_d, dst_d, w_d)
+        tracer.track("tables", rm_d, const_d)
+        with tracer.stage("iterate"):
+            (src_d, dst_d, w_d, comm_all_d, rm_d, prev_d,
+             gained_d, mod_d, iters_d, nc_d, ne2_d,
+             cq_d, cmoved_d, covf_d) = phase_fn(
+                src_d, dst_d, w_d, comm_all_d, rm_d, prev_d,
+                active_at_start, const_d,
+                np.asarray(threshold, dtype=wdt),
+            )
+            gained, (mod_h, iters_h, nc_h, ne2_h, cq_h, cmoved_h,
+                     covf_h) = _phase_sync(
+                gained_d, mod_d, iters_d, nc_d, ne2_d,
+                cq_d, cmoved_d, covf_d)
+        gained = np.asarray(gained, dtype=bool)     # [B, n_sub]
+        phase_wall = time.perf_counter() - t1
+        n_active = max(int(active_at_start.sum()), 1)
+        share = phase_wall / n_active
+
+        traversed = 0
+        for i, s in zip(*np.nonzero(active_at_start)):
+            it = int(iters_h[i, s])
+            tot_iters[i, s] += it
+            traversed += int(ne_cur[i, s]) * it
+            pc = decode_phase_conv(phase, it, cq_h[i, s], cmoved_h[i, s],
+                                   covf_h[i], gained=bool(gained[i, s]))
+            sub_conv[i][s].append(pc)
+            if gained[i, s]:
+                sub_phases[i][s].append(PhaseStats(
+                    phase=len(sub_phases[i][s]),
+                    modularity=float(mod_h[i, s]), iterations=it,
+                    num_vertices=int(nv_cur[i, s]),
+                    num_edges=int(ne_cur[i, s]), seconds=share))
+                nv_cur[i, s] = int(nc_h[i, s])
+                ne_cur[i, s] = int(ne2_h[i, s])
+        tracer.count("traversed_edges", traversed)
+        active = active_at_start & gained \
+            & (tot_iters <= MAX_TOTAL_ITERATIONS)
+        if verbose:
+            print(f"packed phase {phase}: active "
+                  f"{int(active.sum())}/{prep.n_jobs} sub-rows, "
+                  f"iters max {int(iters_h.max())}")
+        tracer.ledger_snapshot(phase)
+        if phase == 0:
+            # One-notch coarse shrink, decided on the MAX over sub-rows
+            # still active (ISSUE 20): every fence interval drops to the
+            # SUB class's serving-coarse class iff every active sub-row
+            # fits — same scalars, same one-binary-decision shape as the
+            # plain batched shrink, so a packed batch compiles at most
+            # two (class, B, n_sub) programs.
+            nv_s, ne_s = cur_nv // n_sub, cur_ne // n_sub
+            cnv_s, cne_s = _coarse_class(nv_s, ne_s)
+            if active.any() and (cnv_s, cne_s) != (nv_s, ne_s) \
+                    and int(nc_h[active].max()) <= cnv_s \
+                    and int(ne2_h[active].max()) <= cne_s:
+                src_d, dst_d, w_d, rm_d = _shrink_subrow_batch(
+                    src_d, dst_d, w_d, rm_d, n_sub=n_sub, nv_sub=nv_s,
+                    cnv_sub=cnv_s, cne_sub=cne_s)
+                cur_nv, cur_ne = n_sub * cnv_s, n_sub * cne_s
+                coarse_class = (cur_nv, cur_ne)
+                phase_fn = _get_batched_phase(
+                    mesh, cur_nv, adt,
+                    _batched_coalesce_engine(cur_nv, adt),
+                    MAX_TOTAL_ITERATIONS, engine="subrow", n_sub=n_sub)
+        phase += 1
+
+    comm_all_h, prev_h = jax.device_get((comm_all_d, prev_d))  # graftlint: disable=R010 — the allowlisted final label gather (packed batch)
+    device_s = time.perf_counter() - t0
+
+    results = []
+    for j in range(prep.n_jobs):
+        i, s = divmod(j, n_sub)
+        nv = int(prep.nv_real[i, s])
+        voff = s * nv_sub0
+        results.append(LouvainResult(
+            communities=np.asarray(
+                comm_all_h[i, voff:voff + nv], dtype=np.int64) - voff,
+            modularity=float(prev_h[i, s]),
+            phases=sub_phases[i][s],
+            total_iterations=int(tot_iters[i, s]),
+            total_seconds=sum(p.seconds for p in sub_phases[i][s]),
+            convergence=sub_conv[i][s],
+        ))
+    return BatchResult(
+        results=results, wall_s=prep.pack_s + device_s, n_phases=phase,
+        b_pad=B, n_jobs=prep.n_jobs, slab_class=prep.slab_class,
+        phase_engines=phase_engines, coarse_class=coarse_class,
+        pack_s=prep.pack_s, device_s=device_s, n_sub=n_sub,
+    )
+
+
 def run_batched(batch: BatchedSlab, *, threshold: float = 1.0e-6,
                 max_phases: int = TERMINATION_PHASE_COUNT,
                 mesh="auto", tracer=None, verbose: bool = False,
@@ -874,6 +1259,47 @@ def pack_many(graphs, *, b_pad: int | None = None,
                              bucket_shape=bucket_shape, tracer=tracer)
     return PreparedMany(graphs_nv=[g.num_vertices for g in graphs],
                         edgeless=edgeless, prep=prep)
+
+
+def pack_subrow_many(graphs, layout, *, b_pad: int | None = None,
+                     mesh="auto", tracer=None) -> PreparedMany:
+    """The PACK stage of a MERGED batch (ISSUE 20): edgeless split +
+    sub-row packing (core/batch.py::pack_subrows) + device upload.
+    Returns the same :class:`PreparedMany` handoff unit as
+    :func:`pack_many` — ``execute_many`` dispatches on the prepared
+    engine, so the pipelined dispatcher runs merged and plain batches
+    through identical stages."""
+    if tracer is None:
+        from cuvite_tpu.utils.trace import NullTracer
+
+        tracer = NullTracer()
+    from cuvite_tpu.core.batch import pack_subrows
+
+    edgeless = {i for i, g in enumerate(graphs) if g.num_edges == 0}
+    packed_graphs = [g for i, g in enumerate(graphs) if i not in edgeless]
+    prep = None
+    if packed_graphs:
+        with tracer.stage("plan"):
+            packed = pack_subrows(packed_graphs, layout, b_pad=b_pad)
+        prep = prepare_packed(packed, mesh=mesh, tracer=tracer)
+    return PreparedMany(graphs_nv=[g.num_vertices for g in graphs],
+                        edgeless=edgeless, prep=prep)
+
+
+def cluster_packed(graphs, layout, *, threshold: float = 1.0e-6,
+                   max_phases: int = TERMINATION_PHASE_COUNT,
+                   b_pad: int | None = None, mesh="auto", tracer=None,
+                   verbose: bool = False) -> BatchResult:
+    """Sub-row-pack small-class graphs and run them as ONE merged batch
+    of ``layout.row_class`` rows — the packed analog of
+    :func:`cluster_many` (in-order results, edgeless answered inline).
+    Per-tenant labels and Q are bit-identical to each graph's B=1 run:
+    the fences make every per-run float content-local
+    (louvain/subrow.py's module note carries the argument)."""
+    pm = pack_subrow_many(graphs, layout, b_pad=b_pad, mesh=mesh,
+                          tracer=tracer)
+    return execute_many(pm, threshold=threshold, max_phases=max_phases,
+                        tracer=tracer, verbose=verbose)
 
 
 def execute_many(pm: PreparedMany, *, threshold: float = 1.0e-6,

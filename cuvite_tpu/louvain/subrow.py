@@ -1,0 +1,245 @@
+"""Sub-row packed Louvain: 2^k fenced small graphs per compiled row
+(ISSUE 20).
+
+A packed row (core/batch.py::pack_subrows) embeds ``n_sub`` disjoint
+small-class graphs in one row of the ``n_sub``-times-larger class:
+sub-row ``s`` owns vertex ids ``[s*nv_sub, (s+1)*nv_sub)`` and no edge
+crosses a seam.  The whole-row step below is
+louvain/step.py::louvain_step_local with exactly three generalizations,
+each an identity when ``n_sub == 1``:
+
+  * the gain's ``1/(2m)`` scalar becomes a PER-SUB-ROW constant,
+    gathered per candidate run by its source vertex's sub-row;
+  * modularity/Q is a ``[n_sub]`` vector — the whole-row sums reshape
+    to ``[n_sub, nv_sub]`` and reduce the minor axis, which is the SAME
+    reduction shape ``jax.vmap`` gives a B=1 batched row (the existing
+    served==solo precedent), so per-sub-row Q is bit-identical to the
+    solo run's scalar;
+  * the phase loop freezes each sub-row's labels the iteration ITS OWN
+    ``(mod - prev) < threshold`` criterion fires — extra iterations run
+    for a packed neighbor never touch a converged sub-row's labels.
+
+Everything else — community tables, neighbor-community sort, run sums,
+argmax tie-breaks, the singleton-swap guard — is the whole-row op it
+always was: fences guarantee per-community and per-vertex segment sums
+only ever mix one sub-row's values, and the packed sort preserves each
+sub-row's relative edge order, so every per-run float is bit-identical
+to the solo slab's.  Packed rows are f32-only: the serving queue's
+``accum_class_of`` gate refuses ds32-scale tenants into a merged row
+(a per-program accumulator flip would change batchmates' bits).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from cuvite_tpu.core.types import CONV_ROWS_CAP, MAX_TOTAL_ITERATIONS
+from cuvite_tpu.louvain.step import StepOut
+from cuvite_tpu.ops import segment as seg
+
+# Accumulator tags a packed row may run (see module note): plain f32
+# only.  'ds32' needs per-sub-row double-single pair reductions, which
+# the serving merge gate makes unreachable — refuse loudly instead of
+# silently changing batchmates' accumulation.
+SUBROW_ACCUM_OK = (None, "float32")
+
+
+def _check_accum(accum_dtype):
+    if accum_dtype not in SUBROW_ACCUM_OK:
+        raise ValueError(
+            f"subrow step: accum_dtype={accum_dtype!r} unsupported — "
+            "packed rows are f32-only (the serve merge gate refuses "
+            "ds32-scale tenants via accum_class_of)")
+
+
+def subrow_modularity(counter0, comm_deg, constants, *, n_sub,
+                      accum_dtype=None):
+    """Per-sub-row Q from whole-row per-vertex/per-community tables:
+    ``modularity_terms``'s two sums reshaped to ``[n_sub, nv_sub]`` and
+    reduced over the minor axis (fences make every column of segment
+    ``s`` a value of graph ``s`` alone).  Same multiply association as
+    the scalar path, so bits match the solo run's."""
+    _check_accum(accum_dtype)
+    acc = counter0.dtype if accum_dtype is None else accum_dtype
+    le = jnp.sum(counter0.astype(acc).reshape(n_sub, -1), axis=-1)
+    la2 = jnp.sum(jnp.square(comm_deg.astype(acc)).reshape(n_sub, -1),
+                  axis=-1)
+    c = constants.astype(acc)
+    return le * c - la2 * c * c
+
+
+def subrow_step_local(
+    src,          # [ne_pad] int32: row-local source; pad = nv_total
+    dst,          # [ne_pad] int32: row-local tail id; pad = 0, w = 0
+    w,            # [ne_pad] weight
+    comm,         # [nv_total] community ids (fenced: in-sub-row)
+    vdeg,         # [nv_total] k_i
+    constants,    # [n_sub] 1/(2m) per sub-row (0 on empty sub-rows)
+    *,
+    nv_total: int,
+    n_sub: int,
+    accum_dtype=None,
+) -> StepOut:
+    """One synchronous sweep over a packed row — single-shard only (the
+    batched driver vmaps this; packed rows never vertex-shard).
+    ``StepOut.modularity``/``n_moved`` are ``[n_sub]`` vectors."""
+    _check_accum(accum_dtype)
+    nv_sub = nv_total // n_sub
+    wdt = w.dtype
+    vdt = comm.dtype
+    sentinel = jnp.iinfo(vdt).max
+
+    # --- community info: size + degree over the whole row ----------------
+    comm_deg = seg.segment_sum(vdeg, comm, num_segments=nv_total)
+    comm_size = seg.segment_sum(
+        jnp.ones((nv_total,), dtype=vdt), comm, num_segments=nv_total)
+
+    # --- per-edge community keys ------------------------------------------
+    src_c = jnp.minimum(src, nv_total - 1)
+    csrc = jnp.take(comm, src_c)
+    ckey = jnp.take(comm, dst)
+
+    to_curr = jnp.where(ckey == csrc, w, jnp.zeros_like(w))
+    counter0 = seg.segment_sum(to_curr, src, num_segments=nv_total,
+                               sorted_ids=True)
+    self_w = jnp.where(dst == src, w, jnp.zeros_like(w))
+    self_loop = seg.segment_sum(self_w, src, num_segments=nv_total,
+                                sorted_ids=True)
+    eix = counter0 - self_loop
+
+    # --- neighbor-community aggregation: sort + run segment sums ----------
+    src_s, ckey_s, w_s = seg.sort_edges_by_vertex_comm(
+        src, ckey, w, src_bound=nv_total + 1, key_bound=nv_total)
+    starts = seg.run_starts(src_s, ckey_s)
+    eiy, _ = seg.run_totals(w_s, starts)
+
+    i_s = jnp.minimum(src_s, nv_total - 1)
+    comm_i = jnp.take(comm, i_s)
+    valid = starts & (src_s < nv_total) & (ckey_s != comm_i)
+
+    # --- dQ per candidate run, with the run's OWN sub-row constant --------
+    const_v = jnp.repeat(constants, nv_sub, total_repeat_length=nv_total)
+    const_i = jnp.take(const_v, i_s)
+    k_i = jnp.take(vdeg, i_s)
+    a_y = jnp.take(comm_deg, ckey_s)
+    a_x = jnp.take(comm_deg, comm_i) - k_i
+    gain = 2.0 * (eiy - jnp.take(eix, i_s)) - 2.0 * k_i * (a_y - a_x) * const_i
+    neg_inf = jnp.array(-jnp.inf, dtype=wdt)
+    gain = jnp.where(valid, gain, neg_inf)
+
+    # --- per-vertex argmax, tie-break to smaller community id -------------
+    best_gain = seg.segment_max(gain, src_s, num_segments=nv_total,
+                                sorted_ids=True)
+    is_best = valid & (gain == jnp.take(best_gain, i_s))
+    cand_c = jnp.where(is_best, ckey_s, jnp.full_like(ckey_s, sentinel))
+    best_c = seg.segment_min(cand_c, src_s, num_segments=nv_total,
+                             sorted_ids=True)
+
+    move = best_gain > 0.0
+    best_c_safe = jnp.minimum(best_c, jnp.array(nv_total - 1, dtype=vdt))
+    t_size = jnp.take(comm_size, best_c_safe)
+    c_size = jnp.take(comm_size, comm)
+    guard = (t_size == 1) & (c_size == 1) & (best_c_safe > comm)
+    move = move & ~guard
+    target = jnp.where(move, best_c_safe, comm)
+
+    modularity = subrow_modularity(counter0, comm_deg, constants,
+                                   n_sub=n_sub, accum_dtype=accum_dtype)
+    n_moved = jnp.sum(move.astype(jnp.int32).reshape(n_sub, -1), axis=-1)  # graftlint: width-ok=move is per-VERTEX (nv_total <= 2^28 rows, per-sub-row sum <= 2^28 < 2^31); the slab-extent tag is argmax-index over-approximation, not a real edge-extent reduction
+    return StepOut(target=target, modularity=modularity, n_moved=n_moved)
+
+
+@functools.lru_cache(maxsize=None)
+def _subrow_call(nv_pad, n_sub, accum_dtype):
+    """(comm, extra) adapter over subrow_step_local for the sub-row
+    phase loop (lru-cached for stable static-arg identity, like
+    fused._fused_step_call)."""
+
+    def call(comm, extra):
+        src, dst, w, vdeg, constants = extra
+        out = subrow_step_local(
+            src, dst, w, comm, vdeg, constants,
+            nv_total=nv_pad, n_sub=n_sub, accum_dtype=accum_dtype,
+        )
+        return out.target, out.modularity, out.n_moved, jnp.zeros((), bool)
+
+    return call
+
+
+@functools.partial(jax.jit, static_argnames=("call", "max_iters", "n_sub"))
+def _run_subrow_phase_loop(extra, comm0, threshold, lower, *, call,
+                           max_iters, n_sub):
+    """driver._run_phase_loop with a ``[n_sub]`` convergence carry: a
+    sub-row's labels advance only while ITS criterion keeps gaining,
+    and its no-gain sweep rolls back exactly like the solo loop's (its
+    ``past`` freezes at the last assignment whose gain passed).  All
+    sub-rows start at iteration 0 together, so each one's trajectory —
+    including the ``max_iters`` cap — aligns 1:1 with its solo loop.
+
+    Returns ``(past [nv], prev_mod [n_sub], iters [n_sub], ovf,
+    (cq [n_sub, CAP], cmoved [n_sub, CAP], covf [CAP]))``.
+    """
+    wdt = lower.dtype
+    nv = comm0.shape[0]
+    nv_sub = nv // n_sub
+
+    def cond(c):
+        return ~c[4]
+
+    def body(c):
+        past, comm, prev_mod, iters, _, ovf, active, sub_iters, conv = c
+        target, mod, moved, step_ovf = call(comm, extra)
+        mod = mod.astype(wdt)
+        no_gain = (mod - prev_mod) < threshold      # [n_sub]
+        adv = active & ~no_gain
+        # Per-sub-row telemetry rows: a sub-row records its own sweeps
+        # only (0 moves on its rollback sweep, like the solo loop);
+        # frozen sub-rows' later columns stay 0 and decode slices by
+        # the per-sub-row iteration count.
+        cq, cmoved, covf = conv
+        cq = cq.at[:, iters].set(
+            jnp.where(active, mod, jnp.zeros_like(mod)), mode="drop")
+        cmoved = cmoved.at[:, iters].set(
+            jnp.where(adv, moved.astype(jnp.int32), 0), mode="drop")
+        covf = covf.at[iters].set(step_ovf, mode="drop")
+        iters1 = iters + 1
+        sub_iters = jnp.where(active, iters1, sub_iters)
+        advv = jnp.repeat(adv, nv_sub, total_repeat_length=nv)
+        new_past = jnp.where(advv, comm, past)
+        new_comm = jnp.where(advv, target, comm)
+        new_prev = jnp.where(adv, jnp.maximum(mod, lower), prev_mod)
+        stop = (~jnp.any(adv)) | (iters1 >= max_iters)
+        return (new_past, new_comm, new_prev, iters1, stop,
+                ovf | step_ovf, adv, sub_iters, (cq, cmoved, covf))
+
+    conv0 = (jnp.zeros((n_sub, CONV_ROWS_CAP), dtype=wdt),
+             jnp.zeros((n_sub, CONV_ROWS_CAP), dtype=jnp.int32),
+             jnp.zeros((CONV_ROWS_CAP,), dtype=bool))
+    prev0 = jnp.full((n_sub,), lower, dtype=wdt)
+    init = (comm0, comm0, prev0, jnp.int32(0), jnp.bool_(False),
+            jnp.zeros((), dtype=bool), jnp.ones((n_sub,), dtype=bool),
+            jnp.zeros((n_sub,), dtype=jnp.int32), conv0)
+    past, _, prev_mod, _, _, ovf, _, sub_iters, conv = jax.lax.while_loop(
+        cond, body, init)
+    return past, prev_mod, sub_iters, ovf, conv
+
+
+def subrow_phase(src, dst, w, constants, threshold, *, nv_pad, n_sub,
+                 accum_dtype=None, max_iters=MAX_TOTAL_ITERATIONS):
+    """ONE phase of a packed row: weighted-degree pass + the per-sub-row
+    iteration loop, identity start.  The batched driver lifts this over
+    the batch axis with ``jax.vmap`` exactly like ``fused_phase`` —
+    deliberately not jitted here."""
+    vdeg = seg.segment_sum(w, src, num_segments=nv_pad, sorted_ids=True)
+    wdt = w.dtype
+    lower = jnp.asarray(-1.0, dtype=wdt)
+    comm0 = jnp.arange(nv_pad, dtype=jnp.int32)
+    return _run_subrow_phase_loop(
+        (src, dst, w, vdeg, constants), comm0,
+        jnp.asarray(threshold, dtype=wdt), lower,
+        call=_subrow_call(nv_pad, n_sub,
+                          None if accum_dtype is None else str(accum_dtype)),
+        max_iters=max_iters, n_sub=n_sub)

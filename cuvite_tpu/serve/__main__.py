@@ -97,6 +97,15 @@ def _build_parser() -> argparse.ArgumentParser:
                             "class serves at the BATCH_SIZES rung "
                             "maximizing projected goodput under the "
                             "SLO, capped at --b-max")
+        q.add_argument("--merge-packing", action="store_true",
+                       help="sub-row merge packing (ISSUE 20): small-"
+                            "class bins may pack 2^k jobs per row of a "
+                            "larger served class's compiled program "
+                            "(fenced sub-rows, results bit-identical "
+                            "to B=1); merges on bin overflow, and — "
+                            "with --wait-slo-ms — whenever measured "
+                            "service medians project the packed batch "
+                            "beating the linger wait")
 
     d = sub.add_parser("demo", help="synthetic multi-tenant load")
     common(d)
@@ -145,6 +154,7 @@ def _make_server(args):
         admission=admission, max_retries=args.max_retries,
         retry_base_s=args.retry_base_ms / 1e3,
         autotune_b_max=bool(getattr(args, "autotune_b_max", False)),
+        merge_packing=bool(getattr(args, "merge_packing", False)),
         stream_budget_bytes=int(
             getattr(args, "stream_budget_mb", 256.0) * (1 << 20)))
     return config, faults, LouvainServer
@@ -203,6 +213,7 @@ def main(argv=None) -> int:
                 "admission": config.admission is not None,
                 "pipelined": daemon.pipelined,
                 "autotune": config.autotune_b_max,
+                "merge_packing": config.merge_packing,
                 "fault_plan": faults.spec()}}), flush=True)
             summary = daemon.serve_forever()
         print(json.dumps({"serve_summary": summary}), flush=True)

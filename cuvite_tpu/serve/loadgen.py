@@ -197,6 +197,101 @@ def _run_open_loop_pipelined(server: LouvainServer, graphs, rate: float, *,
         stats=stats, results=pipe.results, conservation=cons)
 
 
+@dataclasses.dataclass
+class MixReport:
+    """A skewed two-class open-loop run (ISSUE 20): the overall
+    LoadReport plus the per-class split and the packing counters the
+    packed-vs-per-class A/B compares."""
+
+    report: LoadReport
+    mix: tuple                # (n_small, n_big) offered
+    classes: dict             # {'small': cls, 'big': cls}
+    per_class: dict           # name -> {offered, done, goodput, waits}
+    merged_batches: int
+    pack_util: float
+    subrow_util: float
+
+    def row(self) -> dict:
+        out = self.report.row()
+        out.update({
+            "merged_batches": self.merged_batches,
+            "pack_util": round(self.pack_util, 4),
+            "subrow_util": round(self.subrow_util, 4),
+        })
+        for name, blk in self.per_class.items():
+            out[f"{name}_goodput_jobs_per_s"] = round(
+                blk["goodput_jobs_per_s"], 3)
+            out[f"{name}_wait_p95_ms"] = round(blk["wait_p95_s"] * 1e3, 3)
+        return out
+
+
+def mix_schedule(smalls, bigs) -> list:
+    """Deterministically interleave two job pools into ONE arrival
+    order with the big jobs spread evenly through it (Bresenham, no
+    RNG): a 90:10 pool split yields every ~10th arrival big.  Returns
+    ``[('small'|'big', graph), ...]`` consuming both pools fully."""
+    total = len(smalls) + len(bigs)
+    out: list = []
+    si = bi = 0
+    for k in range(total):
+        due_big = bi * total <= k * len(bigs)
+        if bi < len(bigs) and (due_big or si >= len(smalls)):
+            out.append(("big", bigs[bi]))
+            bi += 1
+        else:
+            out.append(("small", smalls[si]))
+            si += 1
+    return out
+
+
+def run_mixed_open_loop(server: LouvainServer, smalls, bigs, rate: float, *,
+                        tenants: int = 1, deadline_s: float | None = None,
+                        max_wall_s: float = 3600.0,
+                        pipelined: bool = False) -> MixReport:
+    """Offer a SKEWED two-class mix (``smalls`` + ``bigs`` interleaved
+    by :func:`mix_schedule`) at ``rate`` jobs/s and drain — the ISSUE
+    20 scenario: with ``merge_packing`` on, the small-class bins should
+    ride the big class's compiled program as fenced sub-rows instead of
+    lingering for same-class batchmates.  The per-class split comes
+    from the server's own ``done_by_class``/``waits_by_class``
+    bookkeeping, so the serial and pipelined drives report it the same
+    way."""
+    from cuvite_tpu.core.batch import slab_class_of  # deferred (queue contract)
+
+    if not smalls or not bigs:
+        raise ValueError("a mixed run needs BOTH pools non-empty")
+    classes = {"small": slab_class_of(smalls[0]),
+               "big": slab_class_of(bigs[0])}
+    if classes["small"] == classes["big"]:
+        raise ValueError(
+            f"mix pools share slab class {classes['small']}; a one-class "
+            "mix has nothing to merge — change the big pool's size")
+    schedule = mix_schedule(smalls, bigs)
+    offered = {"small": len(smalls), "big": len(bigs)}
+    rep = run_open_loop(server, [g for _, g in schedule], rate,
+                        tenants=tenants, deadline_s=deadline_s,
+                        max_wall_s=max_wall_s, pipelined=pipelined)
+    split = server.stats.per_class()
+    per_class = {}
+    for name, cls in classes.items():
+        blk = split.get(cls, {"done": 0, "wait_p50_s": 0.0,
+                              "wait_p95_s": 0.0})
+        per_class[name] = {
+            "offered": offered[name],
+            "done": blk["done"],
+            "goodput_jobs_per_s": blk["done"] / max(rep.wall_s, 1e-9),
+            "wait_p50_s": blk["wait_p50_s"],
+            "wait_p95_s": blk["wait_p95_s"],
+        }
+    stats = rep.stats
+    return MixReport(
+        report=rep, mix=(len(smalls), len(bigs)), classes=classes,
+        per_class=per_class,
+        merged_batches=stats.get("merged_batches", 0),
+        pack_util=stats.get("pack_util", 0.0),
+        subrow_util=stats.get("subrow_util", 0.0))
+
+
 def saturation_sweep(make_server, make_graphs, *, start_rate: float,
                      slo_s: float, growth: float = 1.6,
                      max_rounds: int = 8, sustain_frac: float = 0.9,

@@ -141,6 +141,16 @@ class ServeConfig:
     # before LRU eviction kicks in.  A returning tenant whose session
     # survived pays only its delta; an evicted one re-uploads.
     stream_budget_bytes: int = 256 << 20
+    # Mixed-class sub-row merging (ISSUE 20): when on, a due small-class
+    # bin may dispatch as ONE merged batch of a larger served class's
+    # rows — 2^k fenced sub-rows per row (core/batch.py::SubRowLayout),
+    # up to b_max * n_sub jobs per dispatch instead of b_max.  The
+    # packer merges when the bin OVERFLOWS its class cap (depth > b_max)
+    # or when the measured service medians say the packed batch beats
+    # lingering (see LouvainServer._merge_plan).  Results stay
+    # bit-identical to solo runs (the fence construction); poison
+    # isolation splits a merged batch per job at its OWN class.
+    merge_packing: bool = False
 
     def __post_init__(self) -> None:
         # Config-time validation (ISSUE 11 satellite): a bad knob must
@@ -218,6 +228,12 @@ class PackedBatch:
     prep: object = None      # PreparedMany (uploaded device buffers)
     pack_s: float = 0.0      # pack-stage busy seconds (injectable clock)
     results: list | None = None
+    # Sub-row merge provenance (ISSUE 20): the SubRowLayout the batch
+    # packed under (None = plain batch), and the occupied-row count for
+    # the rows_real accounting (a merged batch's b_pad counts ROWS).
+    layout: object = None
+    merged: bool = False
+    rows_real: int = 0
 
 
 class _ClassBin:
@@ -313,6 +329,16 @@ class ServeStats:
     rows_real: int = 0        # graftlint: guarded-by=self.lock
     rows_padded: int = 0      # graftlint: guarded-by=self.lock — total batch rows incl. padding
     linger_dispatches: int = 0  # graftlint: guarded-by=self.lock
+    # Sub-row occupancy (ISSUE 20).  pack_util counts ROWS, which
+    # saturates at 1.0 the moment every row holds one tenant — a merged
+    # batch needs the sub-row ledger to report honest occupancy (and
+    # can never report > 1.0): graphs_real real graphs over
+    # subrow_capacity total sub-row slots (b_pad * n_sub per batch;
+    # n_sub == 1 for plain batches, so the two utilizations coincide
+    # until merging happens).
+    merged_batches: int = 0   # graftlint: guarded-by=self.lock — dispatches that packed sub-rows
+    graphs_real: int = 0      # graftlint: guarded-by=self.lock — real graphs across all batches
+    subrow_capacity: int = 0  # graftlint: guarded-by=self.lock — total sub-row slots dispatched
     busy_s: float = 0.0       # graftlint: guarded-by=self.lock — wall spent inside the batched driver
     # Pipeline telemetry (ISSUE 14).  inflight: jobs popped from a bin
     # but not yet terminal (packed / in the handoff slot / executing) —
@@ -340,6 +366,14 @@ class ServeStats:
     # enqueue->dispatch waits of the last WAIT_WINDOW jobs (seconds).
     wait_samples: collections.deque = dataclasses.field(  # graftlint: guarded-by=self.lock
         default_factory=lambda: collections.deque(maxlen=WAIT_WINDOW))
+    # Per-slab-class breakdown of COMPLETED jobs (ISSUE 20): done
+    # counts and recent wait samples keyed by slab class, so a skewed
+    # mix's bench record can report per-class goodput/wait_p95 without
+    # a second bookkeeping path in the load generator.
+    done_by_class: dict = dataclasses.field(  # graftlint: guarded-by=self.lock
+        default_factory=dict)
+    waits_by_class: dict = dataclasses.field(  # graftlint: guarded-by=self.lock
+        default_factory=dict)
     # sync.RLock is the serve/ synchronization seam: a plain
     # threading.RLock in production, a scheduler-backed twin under the
     # concheck cooperative scheduler (graftlint tier 4).
@@ -348,8 +382,17 @@ class ServeStats:
 
     @property
     def pack_util(self) -> float:
+        """Occupied batch ROWS over padded rows (a merged batch's row
+        is occupied when >= 1 sub-row holds a real graph)."""
         with self.lock:
             return self.rows_real / max(self.rows_padded, 1)
+
+    @property
+    def subrow_util(self) -> float:
+        """Real graphs over total SUB-row capacity — the honest
+        occupancy once sub-row merging is on (ISSUE 20)."""
+        with self.lock:
+            return self.graphs_real / max(self.subrow_capacity, 1)
 
     @property
     def overlap_frac(self) -> float:
@@ -419,6 +462,22 @@ class ServeStats:
             samples = list(self.wait_samples)
         return percentile(samples, 95.0)
 
+    def per_class(self) -> dict:
+        """``{slab_class: {done, wait_p50_s, wait_p95_s}}`` snapshot —
+        the per-class goodput/latency split a skewed-mix bench record
+        reports (ISSUE 20)."""
+        with self.lock:
+            keys = set(self.done_by_class) | set(self.waits_by_class)
+            out = {}
+            for cls in sorted(keys):
+                samples = list(self.waits_by_class.get(cls, ()))
+                out[cls] = {
+                    "done": self.done_by_class.get(cls, 0),
+                    "wait_p50_s": percentile(samples, 50.0),
+                    "wait_p95_s": percentile(samples, 95.0),
+                }
+            return out
+
     def to_dict(self) -> dict:
         with self.lock:
             samples = list(self.wait_samples)
@@ -431,6 +490,8 @@ class ServeStats:
                 "retries": self.retries,
                 "batches": self.batches,
                 "pack_util": round(self.pack_util, 4),
+                "merged_batches": self.merged_batches,
+                "subrow_util": round(self.subrow_util, 4),
                 "linger_dispatches": self.linger_dispatches,
                 "busy_s": round(self.busy_s, 4),
                 "jobs_per_s": round(self.jobs_per_s, 2),
@@ -643,6 +704,20 @@ class LouvainServer:
         # the per-rung service curve; config.b_max stays the cap.
         self.autotuner = (BmaxAutotuner(self.config.admission)
                           if self.config.autotune_b_max else None)
+        # Sub-row merge decision inputs (ISSUE 20): a DEDICATED
+        # measured-service curve keyed per (bin key | merge key, rung) —
+        # separate from the b_max autotuner so merge_packing without
+        # autotune_b_max never retunes anything.  None without admission
+        # (no SLO/window to size the estimator); the packer then merges
+        # on bin overflow only.
+        self.merge_tuner = (BmaxAutotuner(self.config.admission)
+                            if (self.config.merge_packing
+                                and self.config.admission is not None)
+                            else None)
+        # Slab classes that have COMPLETED at least one batch here —
+        # the merge target set: merging aims small jobs at a larger
+        # class the server is already running programs for.
+        self._served_classes: set = set()  # graftlint: guarded-by=self.stats.lock
         # Tenant slab residency (ISSUE 17): per-tenant resident
         # StreamSessions behind the daemon's `delta` verb, LRU-evicted
         # under the byte budget.  ``stream_factory`` is the chaos seam
@@ -808,10 +883,108 @@ class LouvainServer:
 
     # -- dispatch -----------------------------------------------------------
 
+    # -- sub-row merge decision (ISSUE 20) ----------------------------------
+
+    def _merge_obs_key(self, layout) -> tuple:
+        """Service-curve key of merged batches at one layout — distinct
+        from any bin key, so merged medians never blur plain ones."""
+        return ("merge", layout.row_class, layout.n_sub)
+
+    def _merge_target(self, cls: tuple):
+        """``(SubRowLayout, row_class)`` packing ``cls`` into the
+        SMALLEST larger class this server has already served (its
+        programs are warm), or None when no served class is an exact
+        pow2 sub-row multiple.  Merging never invents a new class: a
+        fresh row class would compile fresh programs mid-serve, the
+        trap the sticky-shape machinery exists to avoid."""
+        from cuvite_tpu.core.batch import subrow_layout_for
+
+        with self.stats.lock:
+            served = sorted(c for c in self._served_classes
+                            if c[0] > cls[0])
+        for rc in served:
+            lay = subrow_layout_for(cls, rc)
+            if lay is not None:
+                return lay, rc
+        return None
+
+    def _merge_plan(self, key, now: float):
+        """Merge-vs-linger for one small-class bin: the SubRowLayout to
+        pack under, or None to serve the bin plain.
+
+        Merge when either
+          * **overflow** — the bin holds more jobs than its class cap
+            ``b_max`` (a plain dispatch would leave the excess queued
+            behind the cap; sub-rows carry ``b_max * n_sub``), or
+          * **measured** — the merge tuner's service medians project
+            the packed batch completing before the plain alternative:
+            ``est(merged @ rows rung) < remaining linger + est(plain @
+            b_max rung)`` — i.e. the packed-batch service beats the
+            small class's linger wait.  Cold medians never merge (the
+            overflow path is what warms them).
+
+        ds32-scale tenants never reach here: their bins carry a
+        non-float32 accum class, refused below (the existing
+        ``accum_class_of`` gate), and the row-class re-gate happens at
+        pack time (louvain/batched.py::prepare_packed's backstop).
+
+        An INJECTED runner (chaos/concheck seam) still merges: the
+        runner receives the popped raw graphs either way, so the whole
+        merge-aware queue discipline (overflow pop past b_max,
+        conservation, poison isolation of a packed batch) is
+        model-checkable without the real packer."""
+        if not self.config.merge_packing:
+            return None
+        cls, acc = key
+        if acc != "float32":
+            return None
+        b = self._bins.get(key)
+        depth = b.depth() if b is not None else 0
+        if depth < 2:
+            return None
+        target = self._merge_target(cls)
+        if target is None:
+            return None
+        layout, _row_cls = target
+        b_max = self.b_max_for(key)
+        if depth > b_max:
+            return layout
+        if self.merge_tuner is None:
+            return None
+        n = min(depth, b_max * layout.n_sub)
+        rows_rung = batch_pad(-(-n // layout.n_sub))
+        with self.stats.lock:
+            merged_curve = self.merge_tuner.curve(
+                self._merge_obs_key(layout))
+            plain_curve = self.merge_tuner.curve(key)
+        # Curve lookup rounds UP to the nearest warmed rung: overflow
+        # merges only ever warm rows-rungs >= 2 (depth > b_max means
+        # ceil(depth / n_sub) rows >= 2 whenever n_sub <= b_max), so an
+        # exact-rung lookup would leave small-depth measured merges
+        # permanently cold.  A larger rung's median upper-bounds the
+        # smaller batch's service — the substitution only ever makes
+        # the decision MORE conservative.
+        def _at(curve: dict, rung: int):
+            if rung in curve:
+                return curve[rung]
+            ge = [r for r in curve if r >= rung]
+            return curve[min(ge)] if ge else None
+
+        est_merged = _at(merged_curve, rows_rung)
+        est_plain = _at(plain_curve, batch_pad(min(depth, b_max)))
+        if est_merged is None or est_plain is None:
+            return None
+        oldest = b.oldest_t_submit()
+        linger_left = max(
+            0.0, self.config.linger_s - (now - (oldest or now)))
+        return layout if est_merged < linger_left + est_plain else None
+
     def _due(self, now: float, force: bool) -> list:
         """Bin keys with a dispatchable batch: full bins always;
         partial bins once their oldest job lingered past the deadline
-        (or on ``force``, the drain path)."""
+        (or on ``force``, the drain path); merge-eligible bins as soon
+        as the measured medians say packing beats lingering (ISSUE
+        20)."""
         due = []
         for key, b in self._bins.items():
             oldest = b.oldest_t_submit()
@@ -819,6 +992,9 @@ class LouvainServer:
                 continue
             if force or b.depth() >= self.b_max_for(key) \
                     or (now - oldest) >= self.config.linger_s:
+                due.append(key)
+            elif self.config.merge_packing \
+                    and self._merge_plan(key, now) is not None:
                 due.append(key)
         return due
 
@@ -831,13 +1007,15 @@ class LouvainServer:
                           slab_class=list(job.slab_class),
                           late_s=round(late, 6))
 
-    def _pop_batch(self, b: _ClassBin, key, now: float) -> list:
-        """Round-robin pop up to the class's effective ``b_max`` jobs,
-        shedding expired ones BEFORE they can occupy a batch row.
-        Surviving jobs are counted in flight (conservation: popped but
-        not yet terminal)."""
+    def _pop_batch(self, b: _ClassBin, key, now: float,
+                   cap: int | None = None) -> list:
+        """Round-robin pop up to the class's effective ``b_max`` jobs
+        (or an explicit ``cap`` — the merge path pops ``b_max * n_sub``,
+        ISSUE 20), shedding expired ones BEFORE they can occupy a batch
+        row.  Surviving jobs are counted in flight (conservation:
+        popped but not yet terminal)."""
         jobs = []
-        b_max = self.b_max_for(key)
+        b_max = self.b_max_for(key) if cap is None else cap
         while len(jobs) < b_max:
             job = b.pop_rr()
             if job is None:
@@ -860,14 +1038,22 @@ class LouvainServer:
         failure paths) terminate them."""
         now = self.clock() if now is None else now
         for key in self._due(now, force):
-            jobs = self._pop_batch(self._bins[key], key, now)
+            lay = self._merge_plan(key, now)
+            cap = (self.b_max_for(key) * lay.n_sub
+                   if lay is not None else None)
+            jobs = self._pop_batch(self._bins[key], key, now, cap=cap)
             if not jobs:
                 continue  # the whole pop shed
             # Label from the ACTUALLY-PACKED size: a bin that counted
             # as full but shed down to a partial batch is a partial
-            # dispatch in the telemetry, not a 'full' one.
-            trigger = ("full" if len(jobs) >= self.b_max_for(key)
-                       else "drain" if force else "linger")
+            # dispatch in the telemetry, not a 'full' one.  A merge pop
+            # that shed to one survivor packs plain (a lone job needs
+            # no fences).
+            if lay is not None and len(jobs) > 1:
+                trigger = "merge"
+            else:
+                trigger = ("full" if len(jobs) >= self.b_max_for(key)
+                           else "drain" if force else "linger")
             return jobs, key, trigger, now
         return None
 
@@ -928,16 +1114,40 @@ class LouvainServer:
         # no batch row: the padded shape and the pack accounting follow
         # the rows that actually hit the device.
         n_real = sum(1 for j in jobs if j.graph.num_edges > 0)
-        b_pad = batch_pad(n_real) if n_real else 0
+        # Sub-row merge (ISSUE 20): a 'merge'-triggered pop packs its
+        # jobs as fenced sub-rows of the target row class — IF every
+        # job's accumulator stays f32 AT THE ROW CLASS (the padded
+        # reduction length grows n_sub-fold; accum_class_of is the
+        # existing gate, re-evaluated at the row nv_pad).  A batch any
+        # of whose tenants fails the re-gate demotes to a plain pack:
+        # refusal means "serve plain", never "fail the job".
+        layout = None
+        if trigger == "merge" and n_real > 1:
+            target = self._merge_target(cls)
+            if target is not None:
+                from cuvite_tpu.louvain.batched import accum_class_of
+
+                lay = target[0]
+                if all(accum_class_of(j.graph, lay.row_class[0])
+                       == "float32"
+                       for j in jobs if j.graph.num_edges > 0):
+                    layout = lay
+        rows_real = (-(-n_real // layout.n_sub) if layout is not None
+                     else n_real)
+        b_pad = batch_pad(rows_real) if n_real else 0
         # Queue-wait latency of THIS batch's jobs (enqueue -> dispatch
         # decision), on the injectable clock: per-batch percentiles ride
         # the pack span; the rolling aggregate feeds the serve summary.
         waits = [max(now - j.t_submit, 0.0) for j in jobs]
         packed = PackedBatch(jobs=jobs, key=key, trigger=trigger, now=now,
-                             n_real=n_real, b_pad=b_pad, waits=waits)
+                             n_real=n_real, b_pad=b_pad, waits=waits,
+                             layout=layout, merged=layout is not None,
+                             rows_real=rows_real)
         sid = self.tracer.begin_span(
             "pack", slab_class=list(cls), jobs=len(jobs), b_pad=b_pad,
             trigger=trigger, engine=self.config.engine,
+            layout=(layout.n_sub if layout is not None else 1),
+            merged=packed.merged,
             tenants=len({j.tenant for j in jobs}),
             wait_p50_s=round(percentile(waits, 50.0), 6),
             wait_p95_s=round(percentile(waits, 95.0), 6))
@@ -952,7 +1162,8 @@ class LouvainServer:
             self.stats.pack_begins(t0)
             try:
                 self.faults.check("pack")
-                if self.config.engine == "bucketed" and n_real:
+                if (self.config.engine == "bucketed" and n_real
+                        and not packed.merged):
                     from cuvite_tpu.core.batch import (
                         bucket_shape_for,
                         union_shapes,
@@ -969,7 +1180,18 @@ class LouvainServer:
                     # extreme degree histogram must not inflate the
                     # class's pinned geometry forever when it never
                     # produces a result.
-                if self._runner is None:
+                if self._runner is None and packed.merged:
+                    # Merged batch: fenced sub-row pack into the row
+                    # class's program.  No bucket-shape union — the
+                    # sub-row engine is plan-free; the compile key is
+                    # (row class, B, n_sub, engine) only.
+                    from cuvite_tpu.louvain.batched import pack_subrow_many
+
+                    packed.prep = pack_subrow_many(
+                        [j.graph for j in jobs], packed.layout,
+                        b_pad=b_pad or None, mesh=self.config.mesh,
+                        tracer=self.tracer)
+                elif self._runner is None:
                     from cuvite_tpu.louvain.batched import pack_many
 
                     packed.prep = pack_many(
@@ -1099,20 +1321,51 @@ class LouvainServer:
                                      else union_shapes(prev, packed.shape))
             if packed.n_real:
                 self.stats.batches += 1
-                self.stats.rows_real += packed.n_real
+                # rows_real counts OCCUPIED ROWS of the dispatched
+                # program (pack_util's numerator); for a merged batch
+                # that is ceil(n_real / n_sub), not the job count —
+                # graphs_real / subrow_capacity carry the finer
+                # sub-row occupancy (subrow_util).
+                self.stats.rows_real += (packed.rows_real or packed.n_real)
                 self.stats.rows_padded += packed.b_pad
+                n_sub = packed.layout.n_sub if packed.merged else 1
+                self.stats.graphs_real += packed.n_real
+                self.stats.subrow_capacity += packed.b_pad * n_sub
+                if packed.merged:
+                    self.stats.merged_batches += 1
+                else:
+                    # Only PLAIN completions certify a class as a merge
+                    # target: a merged batch warms the (row, n_sub)
+                    # sub-row program, not the row class's own plain
+                    # program, and targets must be classes with live
+                    # big-tenant traffic.
+                    self._served_classes.add(cls)
             self.stats.busy_s += service_s
             if packed.trigger == "linger":
                 self.stats.linger_dispatches += 1
             if self.admission is not None and packed.n_real:
                 self.admission.observe(key, service_s)
-        self._maybe_retune(key, packed.b_pad, service_s,
-                           n_real=packed.n_real)
+            if self.merge_tuner is not None and packed.n_real:
+                okey = (self._merge_obs_key(packed.layout) if packed.merged
+                        else key)
+                self.merge_tuner.observe(okey, packed.b_pad, service_s)
+        if not packed.merged:
+            # Merged batches never feed the per-class b_max autotuner:
+            # their rung is row-count at the ROW class, not this small
+            # class's own batch depth — mixing the two would corrupt
+            # the plain-service curve the merge decision compares
+            # against.
+            self._maybe_retune(key, packed.b_pad, service_s,
+                               n_real=packed.n_real)
         out = []
         for job, res, wait in zip(jobs, br.results, packed.waits):
             with self.stats.lock:
                 self.stats.jobs_done += 1
                 self.stats.wait_samples.append(wait)
+                self.stats.done_by_class[cls] = (
+                    self.stats.done_by_class.get(cls, 0) + 1)
+                self.stats.waits_by_class.setdefault(
+                    cls, collections.deque(maxlen=WAIT_WINDOW)).append(wait)
                 self.stats.inflight -= 1
             self.tracer.event(
                 "tenant_result", job_id=job.job_id, tenant=job.tenant,
@@ -1161,14 +1414,21 @@ class LouvainServer:
         now = self.clock() if now is None else now
         out = []
         for key in self._due(now, force):
-            jobs = self._pop_batch(self._bins[key], key, now)
+            lay = self._merge_plan(key, now)
+            cap = (self.b_max_for(key) * lay.n_sub
+                   if lay is not None else None)
+            jobs = self._pop_batch(self._bins[key], key, now, cap=cap)
             if not jobs:
                 continue  # the whole pop shed
             # Label from the ACTUALLY-PACKED size: a bin that counted
             # as full but shed down to a partial batch is a partial
-            # dispatch in the telemetry, not a 'full' one.
-            trigger = ("full" if len(jobs) >= self.b_max_for(key)
-                       else "drain" if force else "linger")
+            # dispatch in the telemetry, not a 'full' one; a merge pop
+            # shed to one survivor packs plain.
+            if lay is not None and len(jobs) > 1:
+                trigger = "merge"
+            else:
+                trigger = ("full" if len(jobs) >= self.b_max_for(key)
+                           else "drain" if force else "linger")
             out.extend(self._dispatch(jobs, key, trigger, now))
         return out
 

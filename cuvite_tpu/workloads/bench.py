@@ -41,7 +41,10 @@ BASELINE_EDGES_PER_SEC_PER_CHIP = 1.0e9 / 64.0
 
 # Bench record schema generation (ISSUE 6): v4 records are
 # self-describing via this field; validate_record enforces the v4 keys.
-BENCH_SCHEMA_VERSION = 4
+# v5 (ISSUE 20) adds the optional `mix` block — a skewed two-class
+# open-loop run's per-class goodput/wait split plus the sub-row packing
+# counters; v4 records without it stay valid.
+BENCH_SCHEMA_VERSION = 5
 
 REQUIRED_RECORD_KEYS = (
     "metric", "value", "unit", "vs_baseline", "platform", "graph",
@@ -181,6 +184,62 @@ def validate_record(rec: dict) -> list:
         # the run used — a two-level record must carry its (dcn, ici)
         # factorization and per-device table/ghost bytes.
         problems.extend(_validate_exchange_block(rec.get("exchange")))
+        # Optional `mix` block (schema v5, ISSUE 20): a skewed
+        # two-class run — per-class goodput/wait_p95 plus the sub-row
+        # packing counters of the packed-vs-per-class A/B.
+        problems.extend(_validate_mix_block(rec.get("mix")))
+    return problems
+
+
+# Required keys of the optional `mix` bench block (schema v5 + ISSUE
+# 20): one skewed two-class open-loop run.  merge_packing — which A/B
+# arm ran (sub-row merging on, or plain per-class queues); the
+# per-class goodput/wait split is what the acceptance compares at equal
+# SLO; pack_util (occupied ROWS / padded rows) vs subrow_util (real
+# graphs / total sub-row slots) are the two occupancy views that
+# diverge exactly when merging happens; merged_batches counts the
+# dispatches that actually packed sub-rows (0 in the per-class arm, and
+# perf_regress refuses to compare across arms).
+REQUIRED_MIX_KEYS = ("merge_packing", "small_goodput_jobs_per_s",
+                     "big_goodput_jobs_per_s", "small_wait_p95_ms",
+                     "big_wait_p95_ms", "pack_util", "merged_batches",
+                     "subrow_util")
+
+
+def _validate_mix_block(mix) -> list:
+    if mix is None:
+        return []
+    if not isinstance(mix, dict):
+        return [f"mix must be a dict, got {type(mix).__name__}"]
+    problems = [f"mix block missing key {k!r}"
+                for k in REQUIRED_MIX_KEYS if k not in mix]
+    if problems:
+        return problems
+    if not isinstance(mix["merge_packing"], bool):
+        problems.append(
+            f"mix.merge_packing must be a bool, got "
+            f"{mix['merge_packing']!r}")
+    for k in ("small_goodput_jobs_per_s", "big_goodput_jobs_per_s",
+              "small_wait_p95_ms", "big_wait_p95_ms"):
+        v = mix[k]
+        if not isinstance(v, (int, float)) or v < 0:
+            problems.append(f"mix.{k} must be non-negative, got {v!r}")
+    pu = mix["pack_util"]
+    if not isinstance(pu, (int, float)) or not 0.0 < pu <= 1.0:
+        problems.append(
+            f"mix.pack_util must be a fraction in (0, 1], got {pu!r}")
+    su = mix["subrow_util"]
+    if not isinstance(su, (int, float)) or not 0.0 < su <= 1.0:
+        problems.append(
+            f"mix.subrow_util must be a fraction in (0, 1], got {su!r}")
+    mb = mix["merged_batches"]
+    if not isinstance(mb, int) or mb < 0:
+        problems.append(
+            f"mix.merged_batches must be a non-negative int, got {mb!r}")
+    if mix["merge_packing"] is False and mb != 0:
+        problems.append(
+            "mix.merged_batches must be 0 when merge_packing is off "
+            f"(got {mb}) — the per-class arm cannot have merged")
     return problems
 
 
@@ -966,6 +1025,205 @@ def run_serve_bench(
             "edges_each": int(edges),
             "linger_ms": float(linger_ms),
             "wall_s": round(rep.wall_s, 3),
+        },
+    }
+
+
+def warm_subrow_rungs(smalls, layout, b_max: int) -> None:
+    """Merged-program compile warm-up (ISSUE 20): one packed batch at
+    every rows-rung <= ``b_max`` under ``layout`` — a merge pops up to
+    ``b_max * n_sub`` jobs, so packed dispatches pad to any rows-rung
+    up to the class cap.  Sub-row OCCUPANCY never enters the compile
+    key, so warming each rung at whatever occupancy the pool allows
+    covers every packed batch the timed run can dispatch."""
+    from cuvite_tpu.core.batch import BATCH_SIZES, batch_pad
+    from cuvite_tpu.louvain.batched import cluster_packed
+
+    b_max = min(batch_pad(int(b_max)), BATCH_SIZES[-1])
+    for r in (r for r in BATCH_SIZES if r <= b_max):
+        take = min(r * layout.n_sub, len(smalls))
+        cluster_packed(smalls[:take], layout, b_pad=r)
+
+
+def run_mixed_serve_bench(
+    *,
+    rate: float,
+    merge_packing: bool,
+    b_max: int = 4,
+    small_edges: int = 1024,
+    big_scale: int = 13,
+    big_edge_factor: int = 2,
+    n_small: int | None = None,
+    n_big: int | None = None,
+    seed: int = 1,
+    slo_ms: float = 500.0,
+    linger_ms: float = 20.0,
+    engine: str = "bucketed",
+    platform: str = "cpu",
+    budget_s: float = 420.0,
+    pipelined: bool = False,
+    t_start: float | None = None,
+) -> dict:
+    """Skewed two-class open-loop serving bench (ISSUE 20): a 90:10
+    small:big arrival mix (``mix_schedule``) offered at ``rate`` jobs/s
+    to one server, drained, and reported with the per-class split —
+    the ``merge_packing`` flag is THE A/B axis: on, small-class bins
+    may pack as fenced sub-rows of the big class's compiled program;
+    off, each class queues and batches strictly among its own.
+
+    Compile discipline: warm-up covers every plain rung of BOTH
+    classes (warm_serve_rungs per pool) and, in the merged arm, every
+    packed rows-rung (warm_subrow_rungs) — the timed loop then runs
+    under the same compile guard as every other bench.
+    """
+    from cuvite_tpu.core.batch import (
+        BATCH_SIZES,
+        batch_pad,
+        slab_class_of,
+        subrow_layout_for,
+    )
+    from cuvite_tpu.io.generate import generate_rmat
+    from cuvite_tpu.obs import (
+        NO_TRACE,
+        CompileWatcher,
+        FlightRecorder,
+        convergence_summary,
+    )
+    from cuvite_tpu.serve import AdmissionConfig, LouvainServer, ServeConfig
+    from cuvite_tpu.serve.loadgen import run_mixed_open_loop
+    from cuvite_tpu.utils.trace import Tracer, rss_high_water_mb
+    from cuvite_tpu.workloads.synth import many_seed, synthesize_graph
+
+    t_start = _T_PROC if t_start is None else t_start
+    if rate <= 0:
+        raise ValueError(f"mix rate must be > 0 jobs/s, got {rate}")
+    b_max = min(batch_pad(int(b_max)), BATCH_SIZES[-1])
+    # 90:10 by COUNT: nine smalls per big, enough work that the packed
+    # arm's linger-vs-merge decision actually faces contended bins.
+    if n_big is None:
+        n_big = max(2 * b_max, 8)
+    if n_small is None:
+        n_small = 9 * n_big
+    smalls = [synthesize_graph(small_edges, seed=many_seed(seed, k))
+              for k in range(n_small)]
+    bigs = [generate_rmat(big_scale, edge_factor=big_edge_factor,
+                          seed=seed * 1000 + k) for k in range(n_big)]
+    cls_s, cls_b = slab_class_of(smalls[0]), slab_class_of(bigs[0])
+    layout = subrow_layout_for(cls_s, cls_b)
+    if layout is None:
+        raise ValueError(
+            f"big class {cls_b} is not an exact pow2 sub-row multiple of "
+            f"small class {cls_s}; pick --mix-big-scale/--mix-big-ef so "
+            "the mix has a packable layout")
+    frec = FlightRecorder(NO_TRACE, watch_compiles=False)
+    with CompileWatcher(on_event=frec._on_compile):
+        _, shape_s = warm_serve_rungs(smalls, b_max, engine)
+        _, shape_b = warm_serve_rungs(bigs, b_max, engine)
+        if merge_packing:
+            warm_subrow_rungs(smalls, layout, b_max)
+    elapsed = time.perf_counter() - t_start
+    if elapsed > budget_s:
+        raise RuntimeError(
+            f"mix bench warm-up alone spent {elapsed:.0f}s of the "
+            f"{budget_s:.0f}s budget; shrink --serve-b-max or the pools")
+
+    config = ServeConfig(
+        b_max=b_max, linger_s=linger_ms / 1e3, engine=engine,
+        admission=AdmissionConfig(wait_slo_s=slo_ms / 1e3),
+        merge_packing=bool(merge_packing))
+    tr = Tracer(recorder=frec)
+    server = LouvainServer(config, tracer=tr)
+    if shape_s is not None:
+        server.pin_shape(cls_s, shape_s)
+    if shape_b is not None:
+        server.pin_shape(cls_b, shape_b)
+    with CompileWatcher(on_event=frec._on_compile) as watch:
+        mrep = run_mixed_open_loop(
+            server, smalls, bigs, rate,
+            max_wall_s=max(budget_s - elapsed, 30.0), pipelined=pipelined)
+    if watch.compiles:
+        raise BenchCompileGuardError(watch.compiles)
+    rep = mrep.report
+    if not rep.results:
+        raise RuntimeError("mix bench completed no jobs; lower the rate")
+    if not rep.conservation["ok"]:
+        raise RuntimeError(
+            f"job-conservation violation: {rep.conservation}")
+
+    results = [r for _, r in rep.results]
+    traversed = sum(p.num_edges * p.iterations
+                    for r in results for p in r.phases)
+    teps = traversed / max(rep.wall_s, 1e-9)
+    qs = [float(r.modularity) for r in results]
+    small, big = mrep.per_class["small"], mrep.per_class["big"]
+    print(f"# mix[{'packed' if merge_packing else 'per-class'}]: "
+          f"rate={rate:.1f}/s goodput={rep.goodput_jobs_per_s:.1f}/s "
+          f"small p95={small['wait_p95_s'] * 1e3:.0f}ms "
+          f"big p95={big['wait_p95_s'] * 1e3:.0f}ms "
+          f"merged={mrep.merged_batches} "
+          f"subrow_util={mrep.subrow_util:.2f}", file=sys.stderr)
+    return {
+        "metric": "louvain_teps_per_chip",
+        "value": round(teps, 1),
+        "unit": "traversed_edges/sec",
+        "vs_baseline": round(teps / BASELINE_EDGES_PER_SEC_PER_CHIP, 4),
+        "platform": platform,
+        "graph": (f"mixpl-{small_edges}x{n_small}"
+                  f"+rmat{big_scale}ef{big_edge_factor}x{n_big}"),
+        "modularity": round(sum(qs) / len(qs), 6),
+        "phases": sum(len(r.phases) for r in results),
+        "iterations": sum(int(r.total_iterations) for r in results),
+        "rss_mb": round(rss_high_water_mb(), 1),
+        "compile_guard": {"checked": True, "new_compiles": 0},
+        "stages": tr.breakdown(),
+        "engine": "batched",
+        "schema": BENCH_SCHEMA_VERSION,
+        "convergence_summary": convergence_summary(
+            getattr(results[0], "convergence", None)),
+        "compile_events": [dict(e) for e in frec.compile_events],
+        "hbm_peak_by_buffer": dict(frec.ledger.peak_by_buffer),
+        "serve": {
+            "b_max": int(b_max),
+            "engine": engine,
+            "pipelined": bool(pipelined),
+            "merge_packing": bool(merge_packing),
+            "overlap_frac": rep.stats["overlap_frac"],
+            "pack_s": rep.stats["pack_s"],
+            "device_s": rep.stats["device_s"],
+            "arrival_jobs_per_s": round(rate, 3),
+            "goodput_jobs_per_s": round(rep.goodput_jobs_per_s, 3),
+            "wait_p50_ms": round(rep.wait_p50_s * 1e3, 3),
+            "wait_p95_ms": round(rep.wait_p95_s * 1e3, 3),
+            "slo_ms": float(slo_ms),
+            "slo_met": bool(rep.wait_p95_s * 1e3 <= slo_ms),
+            "admission": True,
+            "reject_rate": round(rep.reject_rate, 4),
+            "shed_rate": round(rep.shed_rate, 4),
+            "offered": int(rep.offered),
+            "done": int(rep.done),
+            "rejected": int(rep.rejected),
+            "shed": int(rep.shed),
+            "failed": int(rep.failed),
+            "edges_each": int(small_edges),
+            "linger_ms": float(linger_ms),
+            "wall_s": round(rep.wall_s, 3),
+        },
+        "mix": {
+            "merge_packing": bool(merge_packing),
+            "ratio": [int(n_small), int(n_big)],
+            "small_class": list(cls_s),
+            "big_class": list(cls_b),
+            "n_sub": int(layout.n_sub),
+            "small_goodput_jobs_per_s": round(
+                small["goodput_jobs_per_s"], 3),
+            "big_goodput_jobs_per_s": round(big["goodput_jobs_per_s"], 3),
+            "small_wait_p95_ms": round(small["wait_p95_s"] * 1e3, 3),
+            "big_wait_p95_ms": round(big["wait_p95_s"] * 1e3, 3),
+            "small_done": int(small["done"]),
+            "big_done": int(big["done"]),
+            "pack_util": round(mrep.pack_util, 4),
+            "subrow_util": round(mrep.subrow_util, 4),
+            "merged_batches": int(mrep.merged_batches),
         },
     }
 

@@ -661,6 +661,46 @@ def test_rule_negative(rule_id, bad, good, rel):
         f"{[f.format() for f in findings if f.rule == rule_id]}"
 
 
+def test_r014_r015_cover_the_packer_path():
+    """ISSUE 20: the per-batch amortization rules extend beyond serve/
+    to the PACKER path — pack_*/prepare_*/unpack_* functions in
+    louvain/batched.py and core/batch.py hold the same contract (one
+    upload, one plan build, zero jit construction per batch, however
+    many tenants a merged sub-row batch carries).  Scope stays
+    per-function: the phase loops in the same modules legitimately run
+    jax calls per iteration."""
+    bad = """
+import jax
+
+from cuvite_tpu.louvain.bucketed import BucketPlan
+
+def pack_subrow_many(graphs):
+    out = []
+    for g in graphs:
+        buf = jax.device_put(g.src)      # upload per TENANT, not per batch
+        plan = BucketPlan.build(g.src, g.dst, g.w, nv_local=4096, base=0)
+        out.append((buf, plan))
+    return out
+
+def _run_phase_loop(xs):
+    # NOT a packer function: in-loop jax here is the phase loop's job
+    for x in xs:
+        x = jax.device_put(x)
+    return xs
+"""
+    for rel in ("cuvite_tpu/louvain/batched.py",
+                "cuvite_tpu/core/batch.py"):
+        found = rules_of(run_source(bad, rel=rel))
+        assert "R014" in found and "R015" in found, (rel, found)
+        # only the packer function's loop fires, not the phase loop's
+        lines = [f.line for f in run_source(bad, rel=rel)
+                 if f.rule == "R014"]
+        assert len(lines) == 1, lines
+    # The same source OUTSIDE the packer scope stays silent.
+    clean = rules_of(run_source(bad, rel="cuvite_tpu/louvain/fused.py"))
+    assert "R014" not in clean and "R015" not in clean
+
+
 def test_registry_ships_at_least_eight_rules():
     rules = all_rules()
     assert len(rules) >= 8
@@ -1124,7 +1164,12 @@ def test_write_baseline_cli_reports_e000(tmp_path, capsys):
     assert len(load_baseline(bl)) == 1
 
 
+@pytest.mark.slow
 def test_cli_gate_matches_library(monkeypatch, capsys):
+    """Tier-2 (slow): this is a second ~13 s full-repo gate scan whose
+    tier-1 coverage lives in test_gate_is_cwd_independent (same
+    run_paths + baseline + gate over SCAN_PATHS) and, for the real CLI
+    surface, test_cli_subprocess_entrypoint."""
     from cuvite_tpu.analysis.__main__ import main
 
     monkeypatch.chdir(REPO)
@@ -2056,6 +2101,38 @@ def test_compile_audit_sabotage_content_in_compile_key():
 
     res = audit_entry("sabotage", run,
                       {"modules": ["sabotaged"],
+                       "content_independent": True})
+    assert any(f.rule == "B002" for f in res.findings), res
+    assert not res.ok
+
+
+def test_compile_audit_sabotage_occupancy_in_compile_key():
+    """ISSUE 20: sub-row OCCUPANCY (how many tenants landed in a packed
+    row — batch content, like the weights) must never become a static.
+    A sabotaged packed-run twin that threads the occupancy count into a
+    static argument recompiles when the second audit run packs a
+    different number of tenants — B002 fires."""
+    import functools
+
+    import jax
+    import numpy as np
+
+    from cuvite_tpu.analysis.jaxpr_audit import audit_entry
+
+    @functools.partial(jax.jit, static_argnames=("n_occupied",))
+    def sabotaged_packed(x, *, n_occupied):
+        # occupancy as a STATIC: every distinct fill level recompiles —
+        # exactly what pack_subrows' runtime sub_valid mask prevents.
+        return x * (x.shape[0] // n_occupied)
+
+    def run(seed):
+        # The audit varies only the content seed; occupancy follows it
+        # the way a skewed serving mix varies fill level batch to batch.
+        n_occupied = 1 + (seed % 2)
+        sabotaged_packed(np.ones(4, np.float32), n_occupied=n_occupied)
+
+    res = audit_entry("sabotage-occupancy", run,
+                      {"modules": ["sabotaged_packed"],
                        "content_independent": True})
     assert any(f.rule == "B002" for f in res.findings), res
     assert not res.ok

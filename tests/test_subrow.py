@@ -1,0 +1,493 @@
+"""Sub-row packing tests (ISSUE 20): fence adversarial bit-identity,
+the ds32 row-class accumulator re-gate, zero-compile occupancy
+invariance, and the serve-side merge-aware packer (overflow merges,
+demote-to-plain, poison isolation of a merged batch, sticky-union
+non-growth, the new stats counters, and the concheck scenario).
+
+The fence contract under test is exact, not tolerance-based: a packed
+sub-row's labels and Q are BIT-identical to the same graph's solo B=1
+run through the batched driver, because the sentinel fences make every
+per-run float content-local.  The adversarial graphs here aim at the
+seams directly — a hub community AT the last sub-row vertex id, a
+max-degree star whose edges fill the sub-row edge span to the brink —
+where an off-by-one in the offset arithmetic would leak community ids
+or edge mass across tenants.
+"""
+
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from cuvite_tpu.core.batch import (
+    SubRowLayout,
+    batch_pad,
+    pack_subrows,
+    slab_class_of,
+    subrow_layout_for,
+    unpack_subrows,
+)
+from cuvite_tpu.core.graph import Graph
+from cuvite_tpu.louvain.batched import (
+    accum_class_of,
+    cluster_packed,
+    pack_subrow_many,
+)
+from cuvite_tpu.louvain.driver import louvain_many
+from cuvite_tpu.serve import LouvainServer, ServeConfig, ServeStats
+from cuvite_tpu.workloads.synth import many_seed, synthesize_graph
+
+SMALL = (4096, 16384)
+BIG = (8192, 32768)
+LAYOUT = subrow_layout_for(SMALL, BIG)
+
+
+# ---------------------------------------------------------------------------
+# Layout geometry (pure numpy)
+
+
+def test_subrow_layout_for_exact_pow2_ratio_only():
+    lay = subrow_layout_for(SMALL, BIG)
+    assert lay is not None and lay.n_sub == 2
+    assert lay.row_class == BIG
+    assert lay.vertex_fences() == (0, 4096, 8192)
+    assert lay.vertex_offset(1) == 4096 and lay.edge_offset(1) == 16384
+    assert subrow_layout_for(SMALL, (16384, 65536)).n_sub == 4
+    # Disagreeing per-dimension ratios cannot fence cleanly.
+    assert subrow_layout_for(SMALL, (8192, 16384)) is None
+    assert subrow_layout_for(SMALL, (8192, 65536)) is None
+    # n_sub must be a pow2 >= 2: same class and 3x are both invalid.
+    assert subrow_layout_for(SMALL, SMALL) is None
+    assert subrow_layout_for(SMALL, (12288, 49152)) is None
+    with pytest.raises(ValueError):
+        SubRowLayout(n_sub=3, sub_class=SMALL)
+
+
+def _ring_graph(nv, seed, extra=0):
+    """Connected small graph: an nv-ring plus `extra` random chords."""
+    rng = np.random.default_rng(seed)
+    src = np.concatenate([np.arange(nv), rng.integers(0, nv, extra)])
+    dst = np.concatenate([(np.arange(nv) + 1) % nv,
+                          rng.integers(0, nv, extra)])
+    keep = src != dst
+    return Graph.from_edges(nv, src[keep], dst[keep])
+
+
+def test_pack_unpack_roundtrip_geometry():
+    graphs = [_ring_graph(64, s, extra=32) for s in range(3)]
+    packed = pack_subrows(graphs, LAYOUT)
+    assert packed.slab_class == BIG
+    assert packed.b_pad == batch_pad(2)          # ceil(3/2) rows
+    # Row-major occupancy: job j at (j // n_sub, j % n_sub).
+    assert packed.sub_valid[0].tolist() == [True, True]
+    assert packed.sub_valid[1].tolist() == [True, False]
+    assert packed.n_jobs == 3
+    assert packed.subrow_util == 3 / (packed.b_pad * 2)
+    # Sub-row 1's edges live at the edge offset, shifted by the vertex
+    # offset; padding carries the ROW sentinel (src == row nv_pad) so a
+    # padded slot can never scatter into a real community.
+    eo, vo = LAYOUT.edge_offset(1), LAYOUT.vertex_offset(1)
+    g1 = graphs[1]
+    seg = packed.src[0, eo:eo + g1.num_edges]
+    assert seg.min() >= vo and seg.max() < vo + LAYOUT.nv_sub
+    pad = packed.src[0, eo + g1.num_edges:]
+    assert (pad == BIG[0]).all()
+    # unpack slices per-tenant labels back out of the fenced row,
+    # shifted back down by the fence base, with the sub-row's own Q.
+    comm = np.broadcast_to(np.arange(BIG[0], dtype=np.int32)[None, :],
+                           (packed.b_pad, BIG[0])).copy()
+    q = np.arange(packed.b_pad * 2, dtype=np.float64).reshape(
+        packed.b_pad, 2)
+    out = unpack_subrows(packed, comm, q)
+    assert len(out) == 3
+    for k, g in enumerate(graphs):
+        labels, qk = out[k]
+        assert labels.shape == (g.num_vertices,)
+        assert np.array_equal(labels, np.arange(g.num_vertices))
+        assert qk == float(q[k // 2, k % 2])
+
+
+# ---------------------------------------------------------------------------
+# Fence adversarial bit-identity (real jax, the tentpole contract)
+
+
+def _hub_graph(nv, hub, seed, extra=64):
+    """Ring + a dense hub at vertex id `hub`: the hub's community is an
+    attractor whose id sits wherever we aim it — at the seam, in these
+    tests."""
+    rng = np.random.default_rng(seed)
+    spokes = rng.choice(nv - 1, size=nv // 8, replace=False)
+    spokes = np.where(spokes >= hub, spokes + 1, spokes) % nv
+    src = np.concatenate([np.arange(nv), np.full(spokes.size, hub),
+                          rng.integers(0, nv, extra)])
+    dst = np.concatenate([(np.arange(nv) + 1) % nv, spokes,
+                          rng.integers(0, nv, extra)])
+    keep = src != dst
+    return Graph.from_edges(nv, src[keep], dst[keep])
+
+
+def _assert_bit_identical(graphs, layout, **kw):
+    res = cluster_packed(graphs, layout, **kw)
+    for k, g in enumerate(graphs):
+        solo = louvain_many([g], **kw).results[0]
+        got = res.results[k]
+        assert got.modularity == solo.modularity, (
+            f"tenant {k}: packed Q {got.modularity!r} != solo "
+            f"{solo.modularity!r} — a fence leaked")
+        assert np.array_equal(got.communities, solo.communities), (
+            f"tenant {k}: packed labels differ from solo B=1")
+
+
+def test_fence_community_id_at_the_seam():
+    """Tier-1 fence pin: tenant 0's hub community lives AT vertex
+    nv_sub-1 (global id 4095) and tenant 1's at vertex 0 (global id
+    4096) — adjacent ids across the fence.  Any cross-seam leak in the
+    packed program's gather/scatter would merge the two hubs; the
+    labels and Q must match each tenant's solo B=1 run bitwise."""
+    g_hi = _hub_graph(4096, hub=4095, seed=1)
+    g_lo = _hub_graph(4096, hub=0, seed=2)
+    assert slab_class_of(g_hi) == SMALL and slab_class_of(g_lo) == SMALL
+    _assert_bit_identical([g_hi, g_lo], LAYOUT, max_phases=2)
+
+
+def test_fence_max_degree_straddles_edge_offset():
+    """Each tenant is a max-degree star whose directed edges fill the
+    16384-edge sub-row span to 16382/16384 — the last real edge sits
+    two slots from the edge offset boundary, so an off-by-one in
+    edge_offset arithmetic reads the neighbor tenant's first edges.
+    Cheap in tier 1: the packed program is already warm from
+    test_fence_community_id_at_the_seam (same row class and B)."""
+    def star(nv, seed):
+        rng = np.random.default_rng(seed)
+        hub = nv - 1
+        others = np.arange(nv - 1)
+        ex_s = rng.integers(0, nv - 1, 4096)
+        ex_d = rng.integers(0, nv - 1, 4096)
+        keep = ex_s != ex_d
+        g = Graph.from_edges(
+            nv, np.concatenate([np.full(nv - 1, hub), ex_s[keep]]),
+            np.concatenate([others, ex_d[keep]]))
+        assert slab_class_of(g) == SMALL, g.num_edges
+        assert g.num_edges > 16000       # near the 16384 boundary
+        return g
+
+    _assert_bit_identical([star(4096, 3), star(4096, 4)], LAYOUT,
+                          max_phases=2)
+
+
+def test_ds32_tenant_refused_from_f32_packed_row():
+    """A tenant past the ds32 scale gate (tw2 >= 2^24) can never enter
+    an f32 packed row: accum_class_of tags it at both classes and
+    prepare_packed's backstop raises — louder is better than silently
+    flipping every batchmate's accumulator."""
+    rng = np.random.default_rng(5)
+    heavy = Graph.from_edges(
+        256, np.arange(256), (np.arange(256) + 1) % 256,
+        weights=np.full(256, 1.0e5))     # tw2 = 2 * 256 * 1e5 >> 2^24
+    light = _ring_graph(256, 6, extra=64)
+    assert accum_class_of(heavy) == "ds32"
+    assert accum_class_of(heavy, BIG[0]) == "ds32"
+    assert accum_class_of(light) == "float32"
+    assert accum_class_of(light, BIG[0]) == "float32"
+    with pytest.raises(ValueError, match="f32-only"):
+        pack_subrow_many([light, heavy], LAYOUT)
+    del rng
+
+
+def test_second_packed_batch_of_different_tenants_zero_compiles():
+    """The packed compile key is (row class, B, n_sub, engine) — batch
+    CONTENT and sub-row OCCUPANCY never enter it.  After one warm
+    packed batch, a second batch of DIFFERENT tenants at HALF the
+    occupancy (one sub-row empty) reuses the program with zero fresh
+    compiles."""
+    from cuvite_tpu.obs import CompileWatcher
+
+    warm = [synthesize_graph(1024, seed=many_seed(31, k)) for k in (0, 1)]
+    cluster_packed(warm, LAYOUT, max_phases=2)
+    fresh = [synthesize_graph(1024, seed=many_seed(32, 9))]
+    with CompileWatcher() as w:
+        res = cluster_packed(fresh, LAYOUT, max_phases=2)
+    assert len(res.results) == 1
+    assert not w.compiles, [c for c in w.compiles]
+
+
+# ---------------------------------------------------------------------------
+# Serve-side merge-aware packer (stub runner, fake clock — queue
+# discipline only; the real-jax twin below pins the bits)
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+def make_graph(seed, nv=16, ne=32):
+    rng = np.random.default_rng(seed)
+    return Graph.from_edges(nv, rng.integers(0, nv, ne),
+                            rng.integers(0, nv, ne))
+
+
+def make_big_graph(seed, nv=8192, ne=9000):
+    """Stub big-class graph: ~9k arcs symmetrize past the 16384-edge
+    floor -> class (8192, 32768), the n_sub=2 merge target of the
+    small floor class."""
+    g = make_graph(seed, nv=nv, ne=ne)
+    assert slab_class_of(g) == BIG
+    return g
+
+
+def stub_result(g):
+    nv = g.num_vertices
+    key = int(np.sum(g.tails)) % 997
+    return types.SimpleNamespace(
+        communities=(np.arange(nv) + key) % max(nv, 1),
+        modularity=key / 997.0, phases=[1], total_iterations=3,
+        num_communities=nv)
+
+
+def make_stub_runner(clock=None, service_s=0.0, calls=None):
+    def runner(graphs, **kw):
+        if calls is not None:
+            calls.append(len(graphs))
+        if clock is not None and service_s:
+            clock.sleep(service_s)
+        return types.SimpleNamespace(
+            results=[stub_result(g) for g in graphs], n_phases=1)
+
+    return runner
+
+
+def make_server(clock, *, runner=None, faults=None, **cfg_kw):
+    cfg_kw.setdefault("engine", "fused")
+    cfg_kw.setdefault("b_max", 2)
+    cfg_kw.setdefault("linger_s", 0.0)
+    cfg_kw.setdefault("merge_packing", True)
+    return LouvainServer(ServeConfig(**cfg_kw), clock=clock,
+                         sleep=clock.sleep, faults=faults,
+                         runner=runner or make_stub_runner(clock))
+
+
+def _serve_big_then_overflow(srv, *, n_small=3):
+    """Certify BIG with a plain batch, then overflow the small bin."""
+    for s in (100, 101):
+        srv.submit(make_big_graph(s))
+    done_big = srv.step()
+    assert len(done_big) == 2
+    small_ids = [srv.submit(make_graph(s)) for s in range(n_small)]
+    return small_ids
+
+
+def test_overflow_merge_pops_past_b_max_and_conserves():
+    clock = FakeClock()
+    calls = []
+    srv = make_server(clock, runner=make_stub_runner(clock, calls=calls))
+    ids = _serve_big_then_overflow(srv)          # 3 smalls vs b_max=2
+    done = dict(srv.step())
+    assert sorted(done) == sorted(ids)           # ONE merged dispatch
+    assert calls == [2, 3]                       # big batch, then 3 > b_max
+    s = srv.stats
+    assert s.merged_batches == 1 and s.jobs_done == 5
+    # Occupancy ledger: big batch b_pad=2 rows of 1 sub-row each; the
+    # merged batch ceil(3/2)=2 rows of 2 -> (2+3) / (2+4).
+    assert s.graphs_real == 5 and s.subrow_capacity == 6
+    assert s.subrow_util == pytest.approx(5 / 6)
+    assert srv.conservation()["ok"] and srv.pending() == 0
+    # Only PLAIN completions certify a merge target: the merged small
+    # batch ran the BIG row program, not the small class's own.
+    assert BIG in srv._served_classes and SMALL not in srv._served_classes
+    per = s.per_class()
+    assert per[SMALL]["done"] == 3 and per[BIG]["done"] == 2
+
+
+def test_no_merge_without_certified_target():
+    """Small jobs overflow but no larger class ever completed a plain
+    batch here: the pop stays plain at b_max (merging never invents a
+    class — a fresh row class would compile fresh programs mid-serve)."""
+    clock = FakeClock()
+    calls = []
+    srv = make_server(clock, runner=make_stub_runner(clock, calls=calls))
+    for s in range(3):
+        srv.submit(make_graph(s))
+    srv.step()
+    srv.step(force=True)
+    assert calls == [2, 1]                       # plain cap, then the rest
+    assert srv.stats.merged_batches == 0
+    assert srv.conservation()["ok"]
+
+
+def test_merge_demotes_to_plain_on_row_class_accum_flip(monkeypatch):
+    """Refusal means serve plain, never fail the job: with the ds32
+    gate lowered so the ROW class's padded reduction length (8192)
+    crosses but the small class (4096) does not, a merge-triggered pop
+    re-gates each tenant at the row class, fails, and packs plain —
+    all jobs complete, nothing merged."""
+    monkeypatch.setattr("cuvite_tpu.louvain.driver.DS_MIN_TOTAL_WEIGHT",
+                        6000.0)
+    clock = FakeClock()
+    calls = []
+    srv = make_server(clock, runner=make_stub_runner(clock, calls=calls))
+    ids = _serve_big_then_overflow(srv)
+    done = dict(srv.step())
+    assert sorted(done) == sorted(ids)
+    # The pop still took all 3 (the merge DECISION ran), but the batch
+    # demoted: merged_batches stays 0.
+    assert calls[-1] == 3
+    assert srv.stats.merged_batches == 0
+    assert srv.stats.jobs_done == 5 and srv.conservation()["ok"]
+
+
+def test_poison_in_merged_batch_isolates_batchmates():
+    """A poison tenant inside the MERGED dispatch must not take its
+    batchmates down: the batch splits, each job re-runs solo (plain, at
+    its own class), the poison job fails terminally ALONE and the
+    survivors complete — every job terminates exactly once."""
+    clock = FakeClock()
+    calls = []
+    smalls = [make_graph(s) for s in range(3)]
+    poison = smalls[1]
+
+    def runner(graphs, **kw):
+        calls.append(len(graphs))
+        if any(g is poison for g in graphs):
+            raise RuntimeError("poison tenant")
+        return types.SimpleNamespace(
+            results=[stub_result(g) for g in graphs], n_phases=1)
+
+    srv = make_server(clock, runner=runner)
+    for s in (100, 101):
+        srv.submit(make_big_graph(s))
+    assert len(srv.step()) == 2                  # certify BIG plain
+    ids = [srv.submit(g) for g in smalls]
+    done = dict(srv.step())
+    assert calls == [2, 3, 1, 1, 1]              # merged raise -> isolation
+    assert sorted(done) == [ids[0], ids[2]]      # batchmates survived
+    assert [jid for jid, _ in srv.failures] == [ids[1]]
+    s = srv.stats
+    assert s.merged_batches == 0 and s.jobs_done == 4 and s.jobs_failed == 1
+    assert srv.conservation()["ok"] and srv.pending() == 0
+
+
+def test_sticky_union_ignores_merged_batches():
+    """Merged batches are plan-free: the sticky bucket-shape union
+    (engine='bucketed') must not grow — not for the small class, not
+    for the row class — when a merged dispatch completes.  The union
+    stays grow-only across PLAIN batches exactly as before."""
+    clock = FakeClock()
+    srv = make_server(clock, engine="bucketed")
+    ids = _serve_big_then_overflow(srv)
+    with srv.stats.lock:
+        before = dict(srv._shapes)
+    assert BIG in before                         # plain big batch recorded
+    done = dict(srv.step())                      # merged small dispatch
+    assert sorted(done) == sorted(ids) and srv.stats.merged_batches == 1
+    with srv.stats.lock:
+        after = dict(srv._shapes)
+    assert after == before                       # merged batch: no growth
+    # A further PLAIN small batch still unions in grow-only fashion.
+    for s in (50, 51):
+        srv.submit(make_graph(s, ne=48))
+    srv.step()
+    with srv.stats.lock:
+        grown = dict(srv._shapes)
+    assert SMALL in grown
+    assert set(grown) >= set(after)
+
+
+def test_merged_counters_race_free_under_stats_lock():
+    """to_dict()/subrow_util/per_class() snapshot the new ISSUE-20
+    counters under the stats lock: a reader hammering them while a
+    writer appends must never see a mutating dict/deque."""
+    import collections
+
+    stats = ServeStats()
+    stop = threading.Event()
+    errors = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                stats.to_dict()
+                _ = stats.subrow_util
+                stats.per_class()
+        except Exception as e:  # pragma: no cover - the failure signal
+            errors.append(e)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    from cuvite_tpu.serve.queue import WAIT_WINDOW
+    for i in range(20000):
+        cls = (4096 << (i % 3), 16384 << (i % 3))
+        with stats.lock:
+            stats.merged_batches += 1
+            stats.graphs_real += 3
+            stats.subrow_capacity += 4
+            stats.done_by_class[cls] = stats.done_by_class.get(cls, 0) + 1
+            stats.waits_by_class.setdefault(
+                cls, collections.deque(maxlen=WAIT_WINDOW)).append(i * 1e-6)
+    stop.set()
+    t.join(timeout=30)
+    assert not errors
+    d = stats.to_dict()
+    assert d["merged_batches"] == 20000
+    assert d["subrow_util"] == pytest.approx(3 / 4)
+    assert sum(v["done"] for v in stats.per_class().values()) == 20000
+
+
+def test_concheck_merge_packer_scenario_clean_with_teeth():
+    """The merge-aware packer under the schedule explorer: intake
+    overflowing a small bin races the big-class batch that certifies
+    the merge target — conservation and exactly-once hold on every
+    interleaving, AND at least one explored schedule actually
+    dispatched merged (the scenario keeps its teeth)."""
+    from cuvite_tpu.analysis import concheck
+
+    fac, expect = concheck.builtin_scenarios()["merge-pack-clean"]
+    assert expect == "clean"
+    scen = fac()
+    rep = concheck.explore(scen, budget=24, seed=3)
+    assert rep.clean, [f.failures or f.races for f in rep.failing]
+    assert scen.merged_batches_seen > 0, (
+        "no explored schedule merged — the scenario lost its targeting")
+
+
+# ---------------------------------------------------------------------------
+# Real-jax merged serving (the bits, end to end)
+
+
+@pytest.mark.slow
+def test_serve_overflow_merge_bit_identical_real_jax():
+    """Slow-tier end-to-end pin (tier-1 siblings:
+    test_overflow_merge_pops_past_b_max_and_conserves for the queue
+    discipline, test_fence_community_id_at_the_seam for the fences):
+    a real big-class batch certifies the target, three real small jobs
+    overflow-merge into ONE row-class dispatch, and every tenant's
+    labels and Q come back bit-identical to its solo B=1 run."""
+    from cuvite_tpu.io.generate import generate_rmat
+
+    clock = FakeClock()
+    srv = LouvainServer(
+        ServeConfig(b_max=2, linger_s=5.0, merge_packing=True),
+        clock=clock, sleep=clock.sleep)
+    bigs = [generate_rmat(13, edge_factor=2, seed=s) for s in (1, 2)]
+    assert slab_class_of(bigs[0]) == BIG
+    for g in bigs:
+        srv.submit(g)
+    assert len(srv.step()) == 2
+    smalls = [synthesize_graph(1024, seed=many_seed(3, k))
+              for k in range(3)]
+    ids = [srv.submit(g) for g in smalls]
+    done = dict(srv.step())
+    assert sorted(done) == sorted(ids)
+    assert srv.stats.merged_batches == 1
+    for jid, g in zip(ids, smalls):
+        solo = louvain_many([g]).results[0]
+        assert done[jid].modularity == solo.modularity
+        assert np.array_equal(done[jid].communities, solo.communities)
+    assert srv.conservation()["ok"] and srv.pending() == 0

@@ -280,7 +280,13 @@ def test_width_summary_rides_cache_warm_equals_cold(tmp_path):
         "width facts must ride the tier-2 summary cache"
 
 
+@pytest.mark.slow
 def test_width_audit_never_touches_lint_cache(tmp_path):
+    """Tier-2 (slow): pays a full ~9 s dynamic width audit to pin a
+    one-time layering invariant (dynamic W00x results never enter the
+    lint cache). The audit's tier-1 sibling is
+    test_width_audit_green_on_current_tree; the cache's byte-stability
+    pins live in the tier-1 cache tests above."""
     from cuvite_tpu.analysis.engine import run_paths
 
     cache = tmp_path / "cache.json"

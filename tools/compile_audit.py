@@ -100,6 +100,20 @@ def _run_serve(seed):
     server.step(force=True)
 
 
+def _run_subrow(seed):
+    """Packed sub-row batch (ISSUE 20): three tiny small-class graphs
+    merged as fenced sub-rows of (8192, 32768) rows.  The compile key
+    is (row class, B, n_sub, engine) — batch CONTENT and sub-row
+    OCCUPANCY are runtime operands, so the content-seed rerun must
+    compile nothing (B002 otherwise)."""
+    from cuvite_tpu.core.batch import subrow_layout_for
+    from cuvite_tpu.louvain.batched import cluster_packed
+
+    layout = subrow_layout_for((4096, 16384), (8192, 32768))
+    cluster_packed(tiny_graphs(b=3, content_seed=seed), layout,
+                   threshold=1.0e-6, max_phases=MAX_PHASES)
+
+
 # Entry registry: name -> run(content_seed).  Names match the manifest.
 ENTRIES = {
     "solo_fused_sort": _run_solo("sort"),
@@ -107,6 +121,7 @@ ENTRIES = {
     "batched_fused_B2": _run_batched("fused"),
     "batched_bucketed_B2": _run_batched("bucketed"),
     "serve_pack_bucketed_B2": _run_serve,
+    "packed_subrow_B2": _run_subrow,
 }
 
 

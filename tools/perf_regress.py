@@ -157,6 +157,25 @@ def comparable(fresh: dict, rec: dict) -> bool:
         if bool(fs.get("pipelined", False)) != bool(rs.get("pipelined",
                                                            False)):
             return False
+        # Sub-row merge packing (ISSUE 20): the packed arm's goodput
+        # sits above the per-class-queue arm's BY DESIGN on a skewed
+        # mix — the two are different experiments, never peers.  A
+        # record with no tag predates ISSUE 20 and ran per-class.
+        if bool(fs.get("merge_packing", False)) != bool(
+                rs.get("merge_packing", False)):
+            return False
+    # Skewed-mix records (ISSUE 20) gate like-for-like only: same A/B
+    # arm (merge_packing, already pinned on the serve block above),
+    # same small:big ratio and the same class pair — a 90:10 mix's
+    # small-class wait profile says nothing about 50:50, and a mix
+    # record never compares against a single-class serve record.
+    fm, rm = fresh.get("mix"), rec.get("mix")
+    if (fm is None) != (rm is None):
+        return False
+    if fm is not None:
+        for k in ("ratio", "small_class", "big_class"):
+            if fm.get(k) != rm.get(k):
+                return False
     # Streaming churn records (ISSUE 17) gate like-for-like only: a
     # stream record never compares against a batch/serve/plain-TEPS
     # record (its cold arm re-clusters a resident slab, not the bench's
@@ -282,6 +301,36 @@ def check_regression(fresh: dict, trajectory: list, threshold: float,
                     f"best {old_gp:.3g} (round {sn}, b_max="
                     f"{fresh['serve'].get('b_max')}, admission="
                     f"{fresh['serve'].get('admission')}); gate allows "
+                    f"{threshold:.0%}")
+    # Skewed-mix gate (ISSUE 20): the SMALL class's goodput of a mix
+    # record against the best comparable mix record — comparable()
+    # already pinned the merge_packing arm, the ratio and the class
+    # pair, so packed and per-class-queue trajectories never gate each
+    # other.  Saturation-conditioned like the serve gate: below
+    # saturation the per-class goodput tracks the offered mix, not the
+    # packer.
+    if isinstance(fresh.get("mix"), dict) and _saturated(
+            fresh.get("serve") or {}):
+        mpeers = [(n, rec) for n, rec in peers
+                  if isinstance(rec.get("mix"), dict)
+                  and _saturated(rec.get("serve") or {})
+                  and isinstance(
+                      rec["mix"].get("small_goodput_jobs_per_s"),
+                      (int, float))]
+        if mpeers and isinstance(
+                fresh["mix"].get("small_goodput_jobs_per_s"),
+                (int, float)):
+            mn, mbest = max(
+                mpeers,
+                key=lambda p: p[1]["mix"]["small_goodput_jobs_per_s"])
+            old_mg = mbest["mix"]["small_goodput_jobs_per_s"]
+            new_mg = fresh["mix"]["small_goodput_jobs_per_s"]
+            if new_mg < old_mg * (1.0 - threshold):
+                problems.append(
+                    f"mix small_goodput_jobs_per_s {new_mg:.3g} is "
+                    f"{1.0 - new_mg / old_mg:.0%} below the trajectory "
+                    f"best {old_mg:.3g} (round {mn}, merge_packing="
+                    f"{fresh['mix'].get('merge_packing')}); gate allows "
                     f"{threshold:.0%}")
     # Streaming-speedup gate (ISSUE 17): cold/delta wall ratio of a
     # churn record against the best comparable stream record

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
-"""Serving saturation load tool (ISSUE 11).
+"""Serving saturation load tool (ISSUE 11; `mix` added by ISSUE 20).
 
-Three subcommands around the open-loop generator (serve/loadgen.py):
+Four subcommands around the open-loop generator (serve/loadgen.py):
 
     # geometric arrival-rate ramp: find max sustainable jobs/s at the SLO
     python tools/serve_load.py sweep --b-max 8 --edges 1024 --slo-ms 500
@@ -15,6 +15,12 @@ Three subcommands around the open-loop generator (serve/loadgen.py):
     # socket at a fixed rate, then SIGTERM it and check the clean drain
     # (the TPU ladder's stage H path)
     python tools/serve_load.py daemon --b-max 8 --rate 20 --jobs 64
+
+    # skewed-mix packing A/B (ISSUE 20): 90:10 small:big open-loop mix,
+    # per-class queues (merge_packing off) vs sub-row packing (on);
+    # two schema-v5 records with a `mix` block; acceptance = packed
+    # wins goodput AND small-class wait_p95 with merged_batches > 0
+    python tools/serve_load.py mix --rate 20 --out-prefix tools/logs/mix_r20
 
 `sweep`/`ab` run in-process (records via workloads.bench.run_serve_bench,
 gated like-for-like by tools/perf_regress.py); `daemon` exercises the
@@ -222,6 +228,64 @@ def cmd_pipeab(args) -> int:
     return 0 if verdict["acceptance"] else 1
 
 
+def cmd_mix(args) -> int:
+    """THE ISSUE-20 acceptance A/B: one 90:10 skewed small:big arrival
+    mix at the same offered rate, served twice — merge_packing on
+    (small bins pack as fenced sub-rows of the big class's program) vs
+    off (strict per-class queues).  Two schema-v5 records with the
+    ``mix`` block; the verdict demands the packed arm beat the
+    per-class arm on BOTH total goodput and small-class wait_p95 at
+    the equal SLO."""
+    _setup_jax(args.host_devices)
+    from cuvite_tpu.workloads.bench import (
+        run_mixed_serve_bench,
+        validate_record,
+    )
+
+    out = {}
+    for packed in (False, True):
+        rec = run_mixed_serve_bench(
+            rate=args.rate, merge_packing=packed, b_max=args.b_max,
+            small_edges=args.edges, big_scale=args.big_scale,
+            big_edge_factor=args.big_edge_factor,
+            n_small=args.n_small, n_big=args.n_big, seed=args.seed,
+            slo_ms=args.slo_ms, linger_ms=args.linger_ms,
+            engine=args.engine, platform=args.platform,
+            budget_s=args.budget, pipelined=args.pipeline == "on")
+        problems = validate_record(rec)
+        if problems:
+            print(f"# invalid record (merge_packing={packed}): {problems}",
+                  file=sys.stderr)
+            return 2
+        out[packed] = rec
+        line = json.dumps(rec)
+        print(line)
+        if args.out_prefix:
+            suffix = "packed" if packed else "perclass"
+            path = f"{args.out_prefix}_{suffix}.json"
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(line + "\n")
+            print(f"# wrote {path}", file=sys.stderr)
+    plain, packed = out[False], out[True]
+    ps, pl = packed["serve"], plain["serve"]
+    pm, lm = packed["mix"], plain["mix"]
+    verdict = {
+        "rate_jobs_per_s": round(args.rate, 3),
+        "perclass_goodput_jobs_per_s": pl["goodput_jobs_per_s"],
+        "packed_goodput_jobs_per_s": ps["goodput_jobs_per_s"],
+        "perclass_small_wait_p95_ms": lm["small_wait_p95_ms"],
+        "packed_small_wait_p95_ms": pm["small_wait_p95_ms"],
+        "merged_batches": pm["merged_batches"],
+        "packed_subrow_util": pm["subrow_util"],
+        "acceptance": bool(
+            ps["goodput_jobs_per_s"] >= pl["goodput_jobs_per_s"]
+            and pm["small_wait_p95_ms"] <= lm["small_wait_p95_ms"]
+            and pm["merged_batches"] > 0),
+    }
+    print(json.dumps({"verdict": verdict}))
+    return 0 if verdict["acceptance"] else 1
+
+
 def _read_ready(proc, timeout_s: float) -> dict:
     """The daemon's readiness line, with a hard deadline (a wedged
     backend init must fail this tool, not hang it)."""
@@ -416,6 +480,34 @@ def _build_parser() -> argparse.ArgumentParser:
                      help="write <prefix>_serial.json / "
                           "<prefix>_pipelined.json")
 
+    mx = sub.add_parser("mix",
+                        help="90:10 skewed-mix packed-vs-per-class A/B "
+                             "(ISSUE 20 acceptance)")
+    common(mx)
+    mx.add_argument("--mix", default="90:10",
+                    help="small:big arrival ratio by count (informational"
+                         " — pool sizes come from --n-small/--n-big; the "
+                         "default pools realize 90:10)")
+    mx.add_argument("--rate", type=float, default=20.0,
+                    help="offered arrival rate over the WHOLE mix")
+    mx.add_argument("--big-scale", type=int, default=13,
+                    help="R-MAT scale of the big pool (default 13 with "
+                         "--big-edge-factor 2 lands in (8192, 32768), an "
+                         "n_sub=2 row class for 1024-edge smalls)")
+    mx.add_argument("--big-edge-factor", type=int, default=2)
+    mx.add_argument("--n-small", type=int, default=None)
+    mx.add_argument("--n-big", type=int, default=None)
+    mx.add_argument("--platform", default="cpu")
+    mx.add_argument("--budget", type=float, default=600.0)
+    mx.add_argument("--out-prefix", default=None,
+                    help="write <prefix>_packed.json / "
+                         "<prefix>_perclass.json")
+    # The packed program is plan-free (fused-style specs); defaulting
+    # the PLAIN arm to bucketed would measure the ISSUE-10 engine gap,
+    # not the packing policy — the A/B runs fused on both arms unless
+    # explicitly overridden.
+    mx.set_defaults(engine="fused")
+
     dm = sub.add_parser("daemon",
                         help="drive a spawned serve daemon over its socket")
     common(dm)
@@ -437,6 +529,8 @@ def main(argv=None) -> int:
         return cmd_ab(args)
     if args.cmd == "pipeab":
         return cmd_pipeab(args)
+    if args.cmd == "mix":
+        return cmd_mix(args)
     return cmd_daemon(args)
 
 

@@ -27,9 +27,11 @@ valuable result first):
   shapes — the first on-chip M00x evidence: collective sequences,
   cross-shape label bit-identity, per-chip HBM scaling laws;
   ISSUE 15), stage J (width audit on the TPU lowering, ISSUE 16),
-  stage K (streaming churn A/B, ISSUE 17), and stage L (flat 8x1 vs
+  stage K (streaming churn A/B, ISSUE 17), stage L (flat 8x1 vs
   two-level 2x4/4x2 exchange A/B + the per-axis ICI-vs-DCN collective
-  microbench, ISSUE 18).
+  microbench, ISSUE 18), and stage M (packed vs per-class serving A/B
+  under the 90/10 skewed open-loop mix — mixed-class sub-row packing's
+  on-chip goodput + wait_p95 verdict, ISSUE 20).
 
 Success marker: tools/TPU_LADDER3_DONE (platform!=cpu bench JSON
 landed).  Every result appends to tools/logs/tpu_ladder_r4.log immediately.
@@ -490,6 +492,31 @@ def stage_l(platform, ndev):
         log("L: exchange_latency --mesh TIMEOUT (1200s)")
 
 
+def stage_m(platform):
+    """Stage M (ISSUE 20): packed-vs-per-class serving A/B under the
+    90/10 skewed open-loop mix on chip.  tools/serve_load.py mix runs
+    both arms (merge_packing off then on) at the same offered rate,
+    compile-guarded with the sub-row rungs pre-warmed, and writes one
+    schema-v5 bench record per arm (the `mix` block: per-class goodput
+    + wait_p95, pack_util, subrow_util, merged_batches).  The verdict
+    line is the on-chip analog of the BASELINE round-20 CPU acceptance
+    row — packed must beat per-class queues on goodput AND small-class
+    wait_p95 with merged_batches > 0."""
+    prefix = os.path.join(REPO, "tools", "logs", "serve_mix_tpu")
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "serve_load.py"),
+             "mix", "--rate", "20", "--platform", platform,
+             "--budget", "900", "--out-prefix", prefix],
+            capture_output=True, text=True, timeout=2400, cwd=REPO)
+        tail = out.stdout.strip().splitlines()
+        log(f"M: mix 90:10 rc={out.returncode} "
+            f"tail={tail[-1] if tail else out.stderr[-200:]} "
+            f"(json: {prefix}_packed.json / {prefix}_perclass.json)")
+    except subprocess.TimeoutExpired:
+        log("M: serve_load mix TIMEOUT (2400s)")
+
+
 def main():
     parts = probe()
     if parts is None:
@@ -591,6 +618,12 @@ def main():
         stage_l(parts[0], int(parts[1]))
     except Exception as e:
         log(f"L: FAILED {type(e).__name__}: {e}")
+    # Stage M (ISSUE 20): packed-vs-per-class serving A/B under the
+    # 90/10 skewed mix — sub-row packing's on-chip goodput/wait_p95 row.
+    try:
+        stage_m(parts[0])
+    except Exception as e:
+        log(f"M: FAILED {type(e).__name__}: {e}")
     if got_tpu_json:
         with open(DONE, "w") as f:
             f.write(time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()) + "\n")
